@@ -1,0 +1,11 @@
+"""TPU-native LLM gateway: OpenAI-compatible fault-tolerant gateway with an
+in-process JAX/XLA/Pallas inference engine.
+
+A from-scratch rebuild of the capability set of fabiojbg/LLMApiGateway
+(see /root/repo/SURVEY.md), designed TPU-first: the gateway routes
+``/v1/chat/completions`` either to remote OpenAI-compatible HTTP providers
+(with fallback chains, retries, rotation, parameter injection) or to a local
+GSPMD-sharded JAX inference engine (``local`` provider) running on TPU.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,20 @@
+from .settings import Settings, get_settings
+from .schemas import (
+    ProviderDetails,
+    FallbackModelRule,
+    ModelFallbackConfig,
+    LocalEngineConfig,
+    ConfigError,
+)
+from .loader import ConfigLoader
+
+__all__ = [
+    "Settings",
+    "get_settings",
+    "ProviderDetails",
+    "FallbackModelRule",
+    "ModelFallbackConfig",
+    "LocalEngineConfig",
+    "ConfigError",
+    "ConfigLoader",
+]

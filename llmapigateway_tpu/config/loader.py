@@ -1,0 +1,245 @@
+"""json5 config loading with validation, atomic hot-reload, and raw-text access.
+
+Behavior parity with the reference's ``ConfigLoader``
+(``llm_gateway_core/config/loader.py:69-282``): load + validate both files at
+startup, semantic cross-checks (every rule's provider must exist, fallback
+provider must exist, warn on unresolvable API-key env vars), and
+validate-then-swap hot reload that never leaves the loader holding a broken
+config. Differences by design:
+
+* Library code **raises** :class:`ConfigError` instead of ``sys.exit(1)``
+  (reference: ``loader.py:74,100,164``) — the entrypoint decides process fate.
+* Exactly one loader instance serves the whole app (the reference leaks a
+  second import-time instance in ``api/v1/models.py:14-16`` which never sees
+  hot reloads — see SURVEY.md §1).
+* Providers may be ``type: local`` (in-process TPU engine) — new capability.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from pathlib import Path
+from typing import Any
+
+import json5
+from pydantic import ValidationError
+
+from .schemas import (
+    ConfigError,
+    FallbackModelRule,
+    ModelFallbackConfig,
+    ProviderDetails,
+)
+
+logger = logging.getLogger(__name__)
+
+PROVIDERS_FILE = "providers.json"
+RULES_FILE = "models_fallback_rules.json"
+
+
+def parse_providers(raw: Any) -> dict[str, ProviderDetails]:
+    """Validate the parsed providers document → {name: ProviderDetails}.
+
+    Accepts the reference's shape — a list of single-key dicts — plus a plain
+    mapping {name: details} for convenience.
+    """
+    entries: list[tuple[str, Any]] = []
+    if isinstance(raw, dict):
+        entries = list(raw.items())
+    elif isinstance(raw, list):
+        for item in raw:
+            if not isinstance(item, dict) or len(item) != 1:
+                raise ConfigError(
+                    "each providers.json entry must be a single-key object "
+                    f"{{name: details}}, got: {item!r}")
+            entries.append(next(iter(item.items())))
+    else:
+        raise ConfigError("providers.json must be a list or object")
+
+    providers: dict[str, ProviderDetails] = {}
+    for name, details in entries:
+        if name in providers:
+            raise ConfigError(f"duplicate provider name {name!r}")
+        try:
+            pd = ProviderDetails.model_validate(details)
+            pd.validate_semantics(name)
+        except (ValidationError, ValueError) as e:
+            raise ConfigError(f"provider {name!r} invalid: {e}") from e
+        providers[name] = pd
+    if not providers:
+        raise ConfigError("providers.json defines no providers")
+    return providers
+
+
+def parse_rules(raw: Any) -> dict[str, ModelFallbackConfig]:
+    """Validate the parsed rules document → {gateway_model_name: config}."""
+    if not isinstance(raw, list):
+        raise ConfigError("models_fallback_rules.json must be a list of rules")
+    rules: dict[str, ModelFallbackConfig] = {}
+    for item in raw:
+        try:
+            rule = ModelFallbackConfig.model_validate(item)
+        except ValidationError as e:
+            raise ConfigError(f"invalid fallback rule: {e}") from e
+        # Last duplicate wins, matching the reference's dict-overwrite behavior
+        # (loader.py:133-164 builds a dict keyed by gateway_model_name).
+        rules[rule.gateway_model_name] = rule
+    return rules
+
+
+def cross_validate(providers: dict[str, ProviderDetails],
+                   rules: dict[str, ModelFallbackConfig],
+                   fallback_provider: str | None = None) -> None:
+    """Semantic checks across the two files (cf. loader.py:102-122,284-314)."""
+    for model_name, cfg in rules.items():
+        for fm in cfg.fallback_models:
+            if fm.provider not in providers:
+                raise ConfigError(
+                    f"rule {model_name!r} references unknown provider {fm.provider!r}")
+    if fallback_provider and fallback_provider not in providers:
+        raise ConfigError(
+            f"FALLBACK_PROVIDER {fallback_provider!r} not in providers.json")
+    for name, pd in providers.items():
+        if pd.type == "remote_http" and pd.apikey and pd.apikey == pd.apikey.upper() \
+                and not os.environ.get(pd.apikey) and "KEY" in pd.apikey:
+            logger.warning(
+                "provider %s: apikey %r looks like an env-var name but is not set; "
+                "it will be sent as a literal key", name, pd.apikey)
+
+
+class ConfigLoader:
+    """Owns the validated provider map and fallback rules, with hot reload.
+
+    Thread-safe: readers get an immutable snapshot reference; reloads build a
+    complete new validated object then swap under a lock.
+    """
+
+    def __init__(self, config_dir: Path | str = ".",
+                 fallback_provider: str | None = None,
+                 require_files: bool = True):
+        self.config_dir = Path(config_dir)
+        self.fallback_provider = fallback_provider
+        self._lock = threading.Lock()
+        self._providers: dict[str, ProviderDetails] = {}
+        self._rules: dict[str, ModelFallbackConfig] = {}
+        self._version = 0           # bumped on every successful (re)load
+        if require_files:
+            self.load()
+
+    # -- paths -------------------------------------------------------------
+    @property
+    def providers_path(self) -> Path:
+        return self.config_dir / PROVIDERS_FILE
+
+    @property
+    def rules_path(self) -> Path:
+        return self.config_dir / RULES_FILE
+
+    # -- loading -----------------------------------------------------------
+    def _read_json5(self, path: Path) -> Any:
+        try:
+            text = path.read_text()
+        except OSError as e:
+            raise ConfigError(f"cannot read {path}: {e}") from e
+        try:
+            return json5.loads(text)
+        except Exception as e:
+            raise ConfigError(f"{path.name} is not valid json5: {e}") from e
+
+    def load(self) -> None:
+        """Initial load of both files; raises ConfigError on any problem."""
+        providers = parse_providers(self._read_json5(self.providers_path))
+        rules = parse_rules(self._read_json5(self.rules_path))
+        cross_validate(providers, rules, self.fallback_provider)
+        with self._lock:
+            self._providers = providers
+            self._rules = rules
+            self._version += 1
+        logger.info("config loaded: %d providers, %d gateway models",
+                    len(providers), len(rules))
+
+    # -- snapshot accessors -------------------------------------------------
+    @property
+    def providers(self) -> dict[str, ProviderDetails]:
+        with self._lock:
+            return self._providers
+
+    @property
+    def rules(self) -> dict[str, ModelFallbackConfig]:
+        with self._lock:
+            return self._rules
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    # -- hot reload (validate-then-swap, never partial) ---------------------
+    def reload_providers(self) -> tuple[bool, str | None]:
+        """Re-read providers.json; on success swap and return (True, None),
+        on failure keep the old config and return (False, reason).
+        Mirrors reference ``reload_providers_config`` (loader.py:236-282)."""
+        try:
+            providers = parse_providers(self._read_json5(self.providers_path))
+            cross_validate(providers, self.rules, self.fallback_provider)
+        except ConfigError as e:
+            logger.error("providers reload rejected: %s", e)
+            return False, str(e)
+        with self._lock:
+            self._providers = providers
+            self._version += 1
+        logger.info("providers hot-reloaded: %d providers", len(providers))
+        return True, None
+
+    def reload_rules(self) -> tuple[bool, str | None]:
+        """Re-read rules; validate against current providers before swapping.
+        Mirrors reference ``reload_fallback_rules`` (loader.py:166-234)."""
+        try:
+            rules = parse_rules(self._read_json5(self.rules_path))
+            cross_validate(self.providers, rules, None)
+        except ConfigError as e:
+            logger.error("rules reload rejected: %s", e)
+            return False, str(e)
+        with self._lock:
+            self._rules = rules
+            self._version += 1
+        logger.info("rules hot-reloaded: %d gateway models", len(rules))
+        return True, None
+
+    # -- raw text for the web editor (comments preserved) --------------------
+    def read_raw(self, which: str) -> str:
+        path = self.providers_path if which == "providers" else self.rules_path
+        return path.read_text()
+
+    def write_raw(self, which: str, text: str) -> None:
+        """Validate text, write it verbatim (preserving comments), hot-reload.
+        Raises ConfigError if the text does not validate; the file is only
+        written after validation passes (unlike the reference, which writes
+        first and can end up with a saved-but-not-loaded file —
+        rules_editor.py:80-92)."""
+        parsed = json5.loads(text)      # raises on syntax error
+        if which == "providers":
+            providers = parse_providers(parsed)
+            cross_validate(providers, self.rules, self.fallback_provider)
+            self.providers_path.write_text(text)
+            with self._lock:
+                self._providers = providers
+                self._version += 1
+        elif which == "rules":
+            rules = parse_rules(parsed)
+            cross_validate(self.providers, rules, None)
+            self.rules_path.write_text(text)
+            with self._lock:
+                self._rules = rules
+                self._version += 1
+        else:
+            raise ValueError(f"unknown config file {which!r}")
+
+
+def resolve_api_key(details: ProviderDetails) -> str | None:
+    """Resolve the provider API key: treat ``apikey`` as an env-var name if one
+    is set, else as the literal key (reference behavior, ``chat.py:96-101``)."""
+    if not details.apikey:
+        return None
+    return os.environ.get(details.apikey) or details.apikey

@@ -1,0 +1,116 @@
+"""Pydantic schemas for the two json5 config files.
+
+Schema-compatible with the reference's on-disk formats so existing configs
+migrate unchanged (``providers.json``: list of single-key dicts name→details,
+cf. ``llm_gateway_core/config/loader.py:14-35``; ``models_fallback_rules.json``:
+list of rule objects, cf. ``loader.py:37-56``), extended with a ``type`` field
+on providers so an in-process TPU engine is "just another provider":
+
+    { "local_tpu": { "type": "local", "engine": { "model_path": ..., ... } } }
+"""
+from __future__ import annotations
+
+from typing import Any
+
+from pydantic import BaseModel, ConfigDict, Field, field_validator
+
+
+class ConfigError(Exception):
+    """Raised on invalid configuration; callers decide whether to exit."""
+
+
+class LocalEngineConfig(BaseModel):
+    """Engine settings for a ``type: local`` provider entry.
+
+    No reference counterpart — the reference proxies only. These knobs shape
+    the JAX serving engine: checkpoint location, mesh layout, batching and
+    KV-cache geometry.
+    """
+    model_config = ConfigDict(extra="forbid")
+
+    model_path: str = ""            # HF checkpoint dir (safetensors); "" → random init
+    architecture: str = "llama"     # model family key in models/registry.py
+    preset: str | None = None       # named config (e.g. "tinyllama-1.1b") when no checkpoint
+    dtype: str = "bfloat16"
+    # Mesh geometry: axis name -> size. Product must equal device count used.
+    mesh: dict[str, int] = Field(default_factory=dict)   # e.g. {"data":1,"model":8}
+    max_batch_size: int = 8
+    max_seq_len: int = 4096
+    kv_page_size: int = 128
+    kv_num_pages: int = 0           # 0 → derived from max_batch_size*max_seq_len
+    prefill_chunk: int = 512
+    max_tokens_default: int = 1024
+    attention: str = "auto"         # "auto" | "pallas" | "reference"
+    tokenizer_path: str | None = None
+
+
+class ProviderDetails(BaseModel):
+    """One provider's connection/engine details.
+
+    Reference counterpart: ``ProviderDetails`` (baseUrl, apikey) at
+    ``loader.py:14-16``; the reference ignores unknown keys (e.g. the
+    "multiple_models" field in its own example) — we accept extras too.
+    """
+    model_config = ConfigDict(extra="allow")
+
+    type: str = "remote_http"       # "remote_http" | "local"
+    baseUrl: str | None = None
+    apikey: str | None = None       # env-var name, or the literal key itself
+    engine: LocalEngineConfig | None = None
+
+    @field_validator("type")
+    @classmethod
+    def _check_type(cls, v: str) -> str:
+        if v not in ("remote_http", "local"):
+            raise ValueError(f"provider type must be 'remote_http' or 'local', got {v!r}")
+        return v
+
+    def validate_semantics(self, name: str) -> None:
+        if self.type == "remote_http" and not self.baseUrl:
+            raise ValueError(f"provider {name!r}: remote_http requires 'baseUrl'")
+        if self.type == "local" and self.engine is None:
+            raise ValueError(f"provider {name!r}: local provider requires 'engine' config")
+
+
+class FallbackModelRule(BaseModel):
+    """One target in a gateway model's fallback chain.
+
+    Reference counterpart: ``FallbackModelRule`` at ``loader.py:37-45``.
+    """
+    model_config = ConfigDict(extra="forbid")
+
+    provider: str
+    model: str
+    use_provider_order_as_fallback: bool = False
+    providers_order: list[str] | None = None
+    retry_delay: float = 0.0
+    retry_count: int = 0
+    custom_body_params: dict[str, Any] | None = None
+    custom_headers: dict[str, str] | None = None
+
+    @field_validator("use_provider_order_as_fallback", mode="before")
+    @classmethod
+    def _coerce_bool(cls, v: Any) -> Any:
+        if isinstance(v, str):
+            return v.strip().lower() == "true"
+        return v
+
+
+class ModelFallbackConfig(BaseModel):
+    """A gateway model: ordered fallback chain + rotation flag.
+
+    Reference counterpart: ``ModelFallbackConfig`` at ``loader.py:47-56``
+    (including the '"true"'-string coercion for ``rotate_models``).
+    """
+    model_config = ConfigDict(extra="forbid")
+
+    gateway_model_name: str
+    fallback_models: list[FallbackModelRule]
+    rotate_models: bool = False
+
+    @field_validator("rotate_models", mode="before")
+    @classmethod
+    def _coerce_bool(cls, v: Any) -> Any:
+        if isinstance(v, str):
+            return v.strip().lower() == "true"
+        return v

@@ -1,0 +1,109 @@
+"""Environment-driven settings.
+
+Behavior parity with the reference's env settings surface
+(``llm_gateway_core/config/settings.py:10-44`` in /root/reference): gateway
+API key, fallback provider, port/host, CORS origins, log limits, debug mode —
+plus engine-oriented knobs the reference has no counterpart for.
+
+Unlike the reference this is not an import-time singleton wired to dotenv
+side effects: construct ``Settings()`` explicitly (reads a ``.env`` file if
+present, then the process environment; env wins), or use :func:`get_settings`
+for the process-wide instance.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from pathlib import Path
+
+
+def _load_dotenv(path: Path) -> dict[str, str]:
+    """Minimal .env parser: KEY=VALUE lines, '#' comments, optional quotes."""
+    out: dict[str, str] = {}
+    try:
+        text = path.read_text()
+    except OSError:
+        return out
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#") or "=" not in line:
+            continue
+        key, _, val = line.partition("=")
+        key = key.strip()
+        val = val.strip()
+        if len(val) >= 2 and val[0] == val[-1] and val[0] in "\"'":
+            val = val[1:-1]
+        if key:
+            out[key] = val
+    return out
+
+
+def _as_bool(val: str | None, default: bool = False) -> bool:
+    if val is None:
+        return default
+    return val.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclass
+class Settings:
+    """Resolved gateway settings. All fields overridable via environment."""
+
+    gateway_api_key: str | None = None
+    fallback_provider: str = "openrouter"
+    gateway_host: str = "0.0.0.0"
+    gateway_port: int = 9100
+    allowed_origins: list[str] = field(default_factory=lambda: ["*"])
+    log_file_limit: int = 15
+    log_chat_messages: bool = False
+    log_level: str = "INFO"
+    debug_mode: bool = False
+    # Directories (relative to base_dir unless absolute)
+    base_dir: Path = field(default_factory=Path.cwd)
+    config_dir: Path | None = None
+    db_dir: Path | None = None
+    logs_dir: Path | None = None
+
+    @classmethod
+    def from_env(cls, base_dir: Path | None = None,
+                 env: dict[str, str] | None = None) -> "Settings":
+        base = Path(base_dir) if base_dir else Path.cwd()
+        merged = _load_dotenv(base / ".env")
+        merged.update(os.environ if env is None else env)
+
+        origins_raw = merged.get("ALLOWED_ORIGINS", "*")
+        origins = [o.strip() for o in origins_raw.split(",") if o.strip()] or ["*"]
+
+        def _path(key: str, default: str) -> Path:
+            p = Path(merged.get(key, default))
+            return p if p.is_absolute() else base / p
+
+        return cls(
+            gateway_api_key=merged.get("GATEWAY_API_KEY") or None,
+            fallback_provider=merged.get("FALLBACK_PROVIDER", "openrouter"),
+            gateway_host=merged.get("GATEWAY_HOST", "0.0.0.0"),
+            gateway_port=int(merged.get("GATEWAY_PORT", "9100")),
+            allowed_origins=origins,
+            log_file_limit=int(merged.get("LOG_FILE_LIMIT", "15")),
+            log_chat_messages=_as_bool(merged.get("LOG_CHAT_MESSAGES"), False),
+            log_level=merged.get("LOG_LEVEL", "INFO").upper(),
+            debug_mode=_as_bool(merged.get("DEBUG_MODE"), False),
+            base_dir=base,
+            config_dir=_path("CONFIG_DIR", "."),
+            db_dir=_path("DB_DIR", "db"),
+            logs_dir=_path("LOGS_DIR", "logs"),
+        )
+
+
+_settings: Settings | None = None
+
+
+def get_settings() -> Settings:
+    global _settings
+    if _settings is None:
+        _settings = Settings.from_env()
+    return _settings
+
+
+def set_settings(s: Settings) -> None:
+    global _settings
+    _settings = s

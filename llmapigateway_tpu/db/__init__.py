@@ -1,0 +1,4 @@
+from .rotation import RotationDB
+from .usage import UsageDB
+
+__all__ = ["RotationDB", "UsageDB"]

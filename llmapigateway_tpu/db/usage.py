@@ -1,0 +1,161 @@
+"""Token-usage ledger in SQLite with period aggregation.
+
+Parity with the reference's ``TokensUsageDB``
+(``llm_gateway_core/db/tokens_usage_db.py``): same logical schema
+(timestamped rows of prompt/completion/total/reasoning/cached tokens, cost,
+model, provider — ``tokens_usage_db.py:37-56``), strftime-bucketed
+aggregation (``:222-304``), paginated latest-records (``:69-117``), count
+(``:200-220``), retention cleanup (``:164-198``; dead code there, actually
+wired here). Inserts never raise into the serving path (``:155-159``).
+
+Extended with per-request serving metrics the reference cannot observe:
+``ttft_ms`` (time to first token) and ``tokens_per_sec`` — the BASELINE
+north-star metrics, visible in the stats UI.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import sqlite3
+import threading
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+logger = logging.getLogger(__name__)
+
+_PERIOD_FMT = {"hour": "%Y-%m-%d %H:00", "day": "%Y-%m-%d",
+               "week": "%Y-%W", "month": "%Y-%m"}
+
+
+@dataclass
+class UsageRecord:
+    model: str = ""
+    provider: str = ""
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    total_tokens: int = 0
+    reasoning_tokens: int = 0
+    cached_tokens: int = 0
+    cost: float = 0.0
+    ttft_ms: float | None = None
+    tokens_per_sec: float | None = None
+    timestamp: str = field(default_factory=lambda: time.strftime("%Y-%m-%d %H:%M:%S"))
+
+
+class UsageDB:
+    def __init__(self, db_dir: Path | str = "db"):
+        path = Path(db_dir)
+        path.mkdir(parents=True, exist_ok=True)
+        self._path = path / "tokens_usage.db"
+        self._lock = threading.Lock()
+        self._conn = sqlite3.connect(self._path, check_same_thread=False)
+        self._conn.row_factory = sqlite3.Row
+        with self._lock:
+            self._conn.execute(
+                """CREATE TABLE IF NOT EXISTS tokens_usage (
+                       id INTEGER PRIMARY KEY AUTOINCREMENT,
+                       timestamp TEXT NOT NULL,
+                       prompt_tokens INTEGER DEFAULT 0,
+                       completion_tokens INTEGER DEFAULT 0,
+                       total_tokens INTEGER DEFAULT 0,
+                       reasoning_tokens INTEGER DEFAULT 0,
+                       cached_tokens INTEGER DEFAULT 0,
+                       cost REAL DEFAULT 0,
+                       model TEXT,
+                       provider TEXT,
+                       ttft_ms REAL,
+                       tokens_per_sec REAL
+                   )""")
+            self._conn.execute(
+                "CREATE INDEX IF NOT EXISTS idx_tokens_usage_ts "
+                "ON tokens_usage(timestamp)")
+            self._conn.commit()
+
+    # -- writes --------------------------------------------------------------
+    def insert(self, rec: UsageRecord) -> None:
+        """Insert one usage row; errors are logged, never raised (the ledger
+        must not break serving — cf. tokens_usage_db.py:155-159)."""
+        try:
+            with self._lock:
+                self._conn.execute(
+                    """INSERT INTO tokens_usage
+                       (timestamp, prompt_tokens, completion_tokens, total_tokens,
+                        reasoning_tokens, cached_tokens, cost, model, provider,
+                        ttft_ms, tokens_per_sec)
+                       VALUES (?,?,?,?,?,?,?,?,?,?,?)""",
+                    (rec.timestamp, rec.prompt_tokens, rec.completion_tokens,
+                     rec.total_tokens, rec.reasoning_tokens, rec.cached_tokens,
+                     rec.cost, rec.model, rec.provider, rec.ttft_ms,
+                     rec.tokens_per_sec))
+                self._conn.commit()
+        except sqlite3.Error:
+            logger.exception("usage insert failed (ignored)")
+
+    async def insert_async(self, rec: UsageRecord) -> None:
+        await asyncio.to_thread(self.insert, rec)
+
+    def cleanup_old_records(self, days: int = 180) -> int:
+        """Delete rows older than `days`; returns count removed."""
+        try:
+            with self._lock:
+                cur = self._conn.execute(
+                    "DELETE FROM tokens_usage WHERE timestamp < "
+                    "datetime('now', ?)", (f"-{int(days)} days",))
+                self._conn.commit()
+                return cur.rowcount
+        except sqlite3.Error:
+            logger.exception("usage cleanup failed (ignored)")
+            return 0
+
+    # -- reads ---------------------------------------------------------------
+    def aggregated(self, period: str, start: str, end: str) -> list[dict[str, Any]]:
+        """SUM per (period-bucket, model) between start/end timestamps.
+        period ∈ {hour, day, week, month} (cf. tokens_usage_db.py:222-304)."""
+        fmt = _PERIOD_FMT.get(period)
+        if fmt is None:
+            raise ValueError(f"unknown period {period!r}")
+        with self._lock:
+            cur = self._conn.execute(
+                f"""SELECT strftime('{fmt}', timestamp) AS period, model,
+                           SUM(prompt_tokens) AS prompt_tokens,
+                           SUM(completion_tokens) AS completion_tokens,
+                           SUM(total_tokens) AS total_tokens,
+                           SUM(reasoning_tokens) AS reasoning_tokens,
+                           SUM(cached_tokens) AS cached_tokens,
+                           SUM(cost) AS cost,
+                           COUNT(*) AS requests,
+                           AVG(ttft_ms) AS avg_ttft_ms,
+                           AVG(tokens_per_sec) AS avg_tokens_per_sec
+                    FROM tokens_usage
+                    WHERE timestamp >= ? AND timestamp <= ?
+                    GROUP BY period, model
+                    ORDER BY period DESC, model""",
+                (start, end))
+            return [dict(r) for r in cur.fetchall()]
+
+    def latest(self, limit: int = 25, offset: int = 0) -> list[dict[str, Any]]:
+        with self._lock:
+            cur = self._conn.execute(
+                "SELECT * FROM tokens_usage ORDER BY id DESC LIMIT ? OFFSET ?",
+                (limit, offset))
+            return [dict(r) for r in cur.fetchall()]
+
+    def total_count(self) -> int:
+        with self._lock:
+            cur = self._conn.execute("SELECT COUNT(*) FROM tokens_usage")
+            return int(cur.fetchone()[0])
+
+    async def aggregated_async(self, period: str, start: str, end: str):
+        return await asyncio.to_thread(self.aggregated, period, start, end)
+
+    async def latest_async(self, limit: int = 25, offset: int = 0):
+        return await asyncio.to_thread(self.latest, limit, offset)
+
+    async def total_count_async(self) -> int:
+        return await asyncio.to_thread(self.total_count)
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()

@@ -1,0 +1,19 @@
+from .base import (
+    Provider,
+    CompletionError,
+    JSONCompletion,
+    StreamingCompletion,
+    CompletionResult,
+    UsageObserver,
+)
+from .remote_http import RemoteHTTPProvider
+
+__all__ = [
+    "Provider",
+    "CompletionError",
+    "JSONCompletion",
+    "StreamingCompletion",
+    "CompletionResult",
+    "UsageObserver",
+    "RemoteHTTPProvider",
+]

@@ -1,0 +1,105 @@
+"""Provider abstraction: the gateway's single most important contract.
+
+Inherited behavioral contract (SURVEY.md §7): *one call that returns
+``(response, error)`` and never raises into the fallback loop* — the property
+that makes fallback, rotation, and local/remote symmetry composable
+(reference: ``make_llm_request`` at ``services/request_handler.py:8``,
+consumed at ``api/v1/chat.py:142``). Two implementations:
+
+* :class:`~.remote_http.RemoteHTTPProvider` — the reference's entire job;
+* ``LocalProvider`` (providers/local.py) — the in-process JAX/TPU engine.
+
+Streaming responses commit to HTTP 200 only after the provider has produced
+its first real data frame (remote: SSE priming; local: prefill admission), so
+errors can still trigger fallback.
+"""
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Protocol
+
+
+@dataclass
+class CompletionError:
+    """Why a provider call failed; feeds the retry/fallback state machine."""
+    detail: str
+    status: int | None = None
+    retryable: bool = True
+
+    def __str__(self) -> str:
+        return f"[{self.status}] {self.detail}" if self.status else self.detail
+
+
+class UsageObserver(Protocol):
+    """Single-parse usage capture: the provider calls these as it parses its
+    own stream, so nothing downstream re-parses SSE (fixes the double-parse
+    in the reference, SURVEY.md §3.2)."""
+
+    def on_first_token(self) -> None: ...
+    def on_content_delta(self, text: str) -> None: ...
+    def on_usage(self, usage: dict[str, Any]) -> None: ...
+    def on_stream_end(self, error: str | None = None) -> None: ...
+
+
+@dataclass
+class NullUsageObserver:
+    def on_first_token(self) -> None: pass
+    def on_content_delta(self, text: str) -> None: pass
+    def on_usage(self, usage: dict[str, Any]) -> None: pass
+    def on_stream_end(self, error: str | None = None) -> None: pass
+
+
+@dataclass
+class StreamingCompletion:
+    """A committed streaming response: raw SSE frames ready to forward.
+
+    ``frames`` yields complete SSE-encoded byte frames (``data: ...\\n\\n``).
+    By the time a StreamingCompletion is returned, the first real frame has
+    already been validated (priming), so the server may send 200.
+    """
+    frames: AsyncIterator[bytes]
+    provider: str = ""
+    model: str = ""
+
+
+@dataclass
+class JSONCompletion:
+    """A successful non-streaming response body (OpenAI chat.completion)."""
+    data: dict[str, Any]
+    provider: str = ""
+    model: str = ""
+
+
+CompletionResult = tuple[
+    "StreamingCompletion | JSONCompletion | None", "CompletionError | None"]
+
+
+@dataclass
+class CompletionRequest:
+    """Everything a provider needs for one upstream attempt, post-routing:
+    payload already rewritten to the provider-real model name with custom
+    body params merged (cf. chat.py:112-123)."""
+    payload: dict[str, Any]
+    stream: bool
+    extra_headers: dict[str, str] = field(default_factory=dict)
+
+
+class Provider(abc.ABC):
+    """A completion backend. Implementations must never raise from
+    :meth:`complete`; all failures become ``(None, CompletionError)``."""
+
+    name: str = ""
+    type: str = ""
+
+    @abc.abstractmethod
+    async def complete(self, request: CompletionRequest,
+                       observer: UsageObserver) -> CompletionResult:
+        ...
+
+    async def list_models(self) -> list[dict[str, Any]] | None:
+        """Optional: the provider's /models inventory (None = unsupported)."""
+        return None
+
+    async def close(self) -> None:
+        pass

@@ -1,0 +1,227 @@
+"""Remote OpenAI-compatible HTTP provider over httpx.
+
+Re-derivation of the one subtle reference algorithm worth keeping
+(``services/request_handler.py:27-152``): **first-frame priming** — when
+streaming, consume upstream SSE frames until the first *real* data frame
+before committing to a 200 streaming response, so in-band upstream errors
+(which many vendors send inside an SSE body with HTTP 200) still trigger
+fallback. Differences by design:
+
+* One pooled ``httpx.AsyncClient`` per provider (keep-alive), not a fresh
+  client per call (reference ``request_handler.py:15`` — a latency tax).
+* SSE frames are parsed exactly once (:class:`~..utils.sse.SSEParser`);
+  usage/content capture happens via the :class:`UsageObserver` the router
+  passes in, not by a second parse in middleware (SURVEY.md §3.2).
+* Mid-stream error frames abort the stream and are reported to the observer;
+  usage frames are captured from the same parse.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Any, AsyncIterator
+
+import httpx
+
+from ..utils.sse import SSEParser, format_sse, frame_error_detail
+from .base import (
+    CompletionError,
+    CompletionRequest,
+    CompletionResult,
+    JSONCompletion,
+    Provider,
+    StreamingCompletion,
+    UsageObserver,
+)
+
+logger = logging.getLogger(__name__)
+
+# Reference timeouts: 300 s total / 60 s connect (request_handler.py:15).
+DEFAULT_TIMEOUT = httpx.Timeout(300.0, connect=60.0)
+MODELS_TIMEOUT = httpx.Timeout(60.0, connect=10.0)
+
+
+def _extract_content_delta(obj: dict[str, Any]) -> str:
+    """Pull the assistant text delta out of a chat.completion(.chunk) frame
+    (cf. chat_logging.py:124-133: delta.content or message.content)."""
+    try:
+        choices = obj.get("choices")
+        if not choices:
+            return ""
+        ch = choices[0]
+        delta = ch.get("delta") or {}
+        msg = ch.get("message") or {}
+        return delta.get("content") or msg.get("content") or ""
+    except (AttributeError, IndexError, TypeError):
+        return ""
+
+
+class RemoteHTTPProvider(Provider):
+    type = "remote_http"
+
+    def __init__(self, name: str, base_url: str, api_key: str | None = None,
+                 client: httpx.AsyncClient | None = None):
+        self.name = name
+        self.base_url = base_url.rstrip("/")
+        self.api_key = api_key
+        self._client = client or httpx.AsyncClient(timeout=DEFAULT_TIMEOUT)
+
+    def _headers(self, extra: dict[str, str]) -> dict[str, str]:
+        headers = {"Content-Type": "application/json"}
+        if self.api_key:
+            headers["Authorization"] = f"Bearer {self.api_key}"
+        headers.update(extra)
+        return headers
+
+    async def complete(self, request: CompletionRequest,
+                       observer: UsageObserver) -> CompletionResult:
+        url = f"{self.base_url}/chat/completions"
+        headers = self._headers(request.extra_headers)
+        try:
+            if request.stream:
+                return await self._complete_streaming(
+                    url, headers, request.payload, observer)
+            return await self._complete_json(
+                url, headers, request.payload, observer)
+        except httpx.HTTPError as e:
+            return None, CompletionError(f"network error contacting {self.name}: {e}")
+        except Exception as e:        # contract: never raise into the fallback loop
+            logger.exception("unexpected provider failure (%s)", self.name)
+            return None, CompletionError(f"provider {self.name} failed: {e}")
+
+    # -- non-streaming -------------------------------------------------------
+    async def _complete_json(self, url: str, headers: dict[str, str],
+                             payload: dict[str, Any],
+                             observer: UsageObserver) -> CompletionResult:
+        resp = await self._client.post(url, json=payload, headers=headers)
+        if resp.status_code >= 400:
+            return None, CompletionError(
+                resp.text[:2000], status=resp.status_code)
+        try:
+            data = resp.json()
+        except ValueError:
+            return None, CompletionError(
+                f"non-JSON response from {self.name}: {resp.text[:500]}")
+        # In-band error with HTTP 200 (request_handler.py:160-172).
+        detail = frame_error_detail(data)
+        if detail is not None:
+            return None, CompletionError(detail, status=resp.status_code)
+        observer.on_first_token()
+        observer.on_content_delta(_extract_content_delta(data))
+        if isinstance(data.get("usage"), dict):
+            observer.on_usage(data["usage"])
+        observer.on_stream_end()
+        return JSONCompletion(data=data, provider=self.name,
+                              model=str(payload.get("model", ""))), None
+
+    # -- streaming -----------------------------------------------------------
+    async def _complete_streaming(self, url: str, headers: dict[str, str],
+                                  payload: dict[str, Any],
+                                  observer: UsageObserver) -> CompletionResult:
+        req = self._client.build_request("POST", url, json=payload, headers=headers)
+        resp = await self._client.send(req, stream=True)
+
+        if resp.status_code >= 400:
+            body = await resp.aread()
+            await resp.aclose()
+            return None, CompletionError(
+                body.decode("utf-8", "replace")[:2000], status=resp.status_code)
+
+        # Priming: pull frames until the first real data frame so we can still
+        # fall back on in-band errors (request_handler.py:67-100).
+        parser = SSEParser()
+        primed: list[bytes] = []           # frames to re-emit once committed
+        byte_iter = resp.aiter_bytes()
+        committed = False
+        try:
+            async for chunk in byte_iter:
+                for frame in parser.feed(chunk):
+                    if frame.is_done:
+                        # Stream ended before any content: treat as error.
+                        await resp.aclose()
+                        return None, CompletionError(
+                            f"{self.name} stream ended with no data")
+                    obj = frame.json
+                    detail = frame_error_detail(obj) if obj is not None else None
+                    if detail is not None:
+                        await resp.aclose()
+                        return None, CompletionError(detail)
+                    if obj is None:
+                        continue           # comment/keep-alive frame — drop
+                    primed.append(format_sse(frame.data))
+                    observer.on_first_token()
+                    text = _extract_content_delta(obj)
+                    if text:
+                        observer.on_content_delta(text)
+                    if isinstance(obj.get("usage"), dict):
+                        observer.on_usage(obj["usage"])
+                    committed = True
+                if committed:
+                    break
+            if not committed:
+                await resp.aclose()
+                return None, CompletionError(
+                    f"{self.name} closed the stream before any data frame")
+        except httpx.HTTPError as e:
+            await resp.aclose()
+            return None, CompletionError(f"stream setup failed: {e}")
+
+        frames = self._relay(resp, byte_iter, parser, primed, observer)
+        return StreamingCompletion(frames=frames, provider=self.name,
+                                   model=str(payload.get("model", ""))), None
+
+    async def _relay(self, resp: httpx.Response, byte_iter: AsyncIterator[bytes],
+                     parser: SSEParser, primed: list[bytes],
+                     observer: UsageObserver) -> AsyncIterator[bytes]:
+        """Yield primed frames then the rest of the stream, watching for
+        mid-stream errors and usage (request_handler.py:102-146)."""
+        error: str | None = None
+        try:
+            for frame_bytes in primed:
+                yield frame_bytes
+            async for chunk in byte_iter:
+                for frame in parser.feed(chunk):
+                    if frame.is_done:
+                        yield format_sse("[DONE]")
+                        continue
+                    obj = frame.json
+                    if obj is not None:
+                        detail = frame_error_detail(obj)
+                        if detail is not None:
+                            # Too late to fall back — surface in-band and stop.
+                            error = detail
+                            yield format_sse({"error": {"message": detail,
+                                                        "provider": self.name}})
+                            return
+                        text = _extract_content_delta(obj)
+                        if text:
+                            observer.on_content_delta(text)
+                        if isinstance(obj.get("usage"), dict):
+                            observer.on_usage(obj["usage"])
+                    yield format_sse(frame.data)
+            for frame in parser.flush():
+                if not frame.is_done:
+                    yield format_sse(frame.data)
+        except httpx.HTTPError as e:
+            error = f"upstream stream error: {e}"
+            yield format_sse({"error": {"message": error, "provider": self.name}})
+        finally:
+            observer.on_stream_end(error)
+            await resp.aclose()
+
+    # -- models --------------------------------------------------------------
+    async def list_models(self) -> list[dict[str, Any]] | None:
+        """GET {base}/models (reference: models.py:239-296), 60 s/10 s."""
+        try:
+            resp = await self._client.get(
+                f"{self.base_url}/models",
+                headers=self._headers({}), timeout=MODELS_TIMEOUT)
+            if resp.status_code >= 400:
+                return None
+            data = resp.json()
+            models = data.get("data") if isinstance(data, dict) else data
+            return models if isinstance(models, list) else None
+        except (httpx.HTTPError, ValueError):
+            return None
+
+    async def close(self) -> None:
+        await self._client.aclose()

@@ -1,0 +1,3 @@
+from .router import Router, ProviderRegistry, RouteOutcome
+
+__all__ = ["Router", "ProviderRegistry", "RouteOutcome"]

@@ -1,0 +1,224 @@
+"""Routing engine: rule resolution, rotation, retry/fallback state machine.
+
+Behavior parity with the reference's routing loop — which lives inline in its
+API handler (``api/v1/chat.py:41-198``) — lifted into a service object so the
+HTTP layer stays thin (SURVEY.md §7 step 2). Semantics preserved:
+
+* Rule lookup by gateway model name; unknown models become a synthetic
+  single-target chain on the configured fallback provider with the model name
+  passed through (``chat.py:48-59``).
+* Rotation: persisted per-(client-key, gateway-model) round-robin start index
+  with circular reorder of the chain (``chat.py:64-78``); DB errors degrade
+  to index 0. The sqlite call is offloaded, never blocking the event loop
+  (the reference blocks — ``chat.py:67``).
+* Per-target retry loop: ``retry_count`` extra attempts, sleeping
+  ``retry_delay`` seconds when ``0 < delay < 120`` (``chat.py:127,191-194``).
+* Payload build per attempt: model rewrite to the provider-real name,
+  OpenRouter ``usage.include`` auto-injection, ``custom_body_params`` /
+  ``custom_headers`` merge, ``HTTP-Referer``/``X-Title`` headers
+  (``chat.py:103-123``); OpenRouter ``provider.order`` pinning, and the
+  ``use_provider_order_as_fallback`` sub-provider loop (``chat.py:137-139,
+  158-189``).
+* Every attempt gets a **fresh deep-copied payload** — deliberately fixing
+  the reference quirk where a failure mutates ``messages`` to ``"<REMOVED>"``
+  and retries send no real messages (``chat.py:150``; SURVEY.md §2a "Quirk").
+* All targets exhausted → a terminal error the server maps to HTTP 503
+  (``chat.py:196-198``).
+"""
+from __future__ import annotations
+
+import asyncio
+import copy
+import logging
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ..config.loader import ConfigLoader, resolve_api_key
+from ..config.schemas import FallbackModelRule, ModelFallbackConfig, ProviderDetails
+from ..db.rotation import RotationDB
+from ..providers.base import (
+    CompletionError,
+    CompletionRequest,
+    JSONCompletion,
+    Provider,
+    StreamingCompletion,
+    UsageObserver,
+)
+from ..providers.remote_http import RemoteHTTPProvider
+
+logger = logging.getLogger(__name__)
+
+MAX_RETRY_DELAY_S = 120.0        # honored window (chat.py:191)
+
+
+class ProviderRegistry:
+    """Builds/caches Provider instances from the live config.
+
+    Instances are reused until the provider's config entry changes. ``local``
+    providers are constructed through a pluggable factory so the gateway can
+    run (and be tested) without importing JAX.
+    """
+
+    def __init__(self, loader: ConfigLoader,
+                 local_factory: Callable[[str, ProviderDetails], Provider] | None = None):
+        self._loader = loader
+        self._local_factory = local_factory
+        self._cache: dict[str, tuple[str, Provider]] = {}   # name -> (fingerprint, provider)
+        self._lock = asyncio.Lock()
+
+    async def get(self, name: str) -> Provider | None:
+        details = self._loader.providers.get(name)
+        if details is None:
+            return None
+        fingerprint = details.model_dump_json()
+        async with self._lock:
+            cached = self._cache.get(name)
+            if cached and cached[0] == fingerprint:
+                return cached[1]
+            if cached:
+                await cached[1].close()
+            provider = self._build(name, details)
+            if provider is not None:
+                self._cache[name] = (fingerprint, provider)
+            return provider
+
+    def _build(self, name: str, details: ProviderDetails) -> Provider | None:
+        if details.type == "local":
+            if self._local_factory is None:
+                logger.error("provider %s is type=local but no engine factory "
+                             "is installed", name)
+                return None
+            return self._local_factory(name, details)
+        return RemoteHTTPProvider(
+            name=name, base_url=details.baseUrl or "",
+            api_key=resolve_api_key(details))
+
+    async def close(self) -> None:
+        async with self._lock:
+            for _, provider in self._cache.values():
+                await provider.close()
+            self._cache.clear()
+
+
+@dataclass
+class RouteOutcome:
+    """Terminal result of routing one request through the fallback chain."""
+    result: StreamingCompletion | JSONCompletion | None
+    error: CompletionError | None
+    attempts: int = 0
+    provider: str = ""
+    model: str = ""
+    errors: list[str] = field(default_factory=list)
+
+
+class Router:
+    def __init__(self, loader: ConfigLoader, registry: ProviderRegistry,
+                 rotation_db: RotationDB, fallback_provider: str = "openrouter",
+                 sleep: Callable[[float], Any] | None = None):
+        self._loader = loader
+        self._registry = registry
+        self._rotation = rotation_db
+        self._fallback_provider = fallback_provider
+        self._sleep = sleep or asyncio.sleep     # injectable for tests
+
+    # -- rule resolution -----------------------------------------------------
+    def resolve_rule(self, gateway_model: str) -> ModelFallbackConfig:
+        rule = self._loader.rules.get(gateway_model)
+        if rule is not None:
+            return rule
+        # Unknown model → passthrough to the fallback provider (chat.py:48-59).
+        return ModelFallbackConfig(
+            gateway_model_name=gateway_model,
+            fallback_models=[FallbackModelRule(
+                provider=self._fallback_provider, model=gateway_model)],
+            rotate_models=False)
+
+    async def _ordered_targets(self, rule: ModelFallbackConfig,
+                               client_key: str) -> list[FallbackModelRule]:
+        targets = list(rule.fallback_models)
+        if rule.rotate_models and len(targets) > 1:
+            start = await self._rotation.next_index_async(
+                client_key, rule.gateway_model_name, len(targets))
+            targets = targets[start:] + targets[:start]
+        return targets
+
+    # -- payload/header construction ------------------------------------------
+    @staticmethod
+    def _build_attempt(payload: dict[str, Any], target: FallbackModelRule,
+                       provider_name: str,
+                       pinned_order: list[str] | None) -> CompletionRequest:
+        attempt = copy.deepcopy(payload)
+        attempt["model"] = target.model
+        if provider_name.lower() == "openrouter":
+            # Ask OpenRouter to report usage/cost (chat.py:114-115).
+            attempt.setdefault("usage", {"include": True})
+            order = pinned_order if pinned_order is not None else target.providers_order
+            if order:
+                attempt["provider"] = {"order": list(order),
+                                       "allow_fallbacks": False}
+        if target.custom_body_params:
+            attempt.update(copy.deepcopy(target.custom_body_params))
+        headers = {"HTTP-Referer": "https://llmapigateway-tpu.local",
+                   "X-Title": "LLM API Gateway (TPU)"}
+        if target.custom_headers:
+            headers.update(target.custom_headers)
+        stream = bool(attempt.get("stream", False))
+        return CompletionRequest(payload=attempt, stream=stream,
+                                 extra_headers=headers)
+
+    # -- the state machine -----------------------------------------------------
+    async def dispatch(self, payload: dict[str, Any], client_key: str,
+                       observer_factory: Callable[[str, str], UsageObserver]) -> RouteOutcome:
+        """Route one chat-completions payload through the fallback chain.
+
+        ``observer_factory(provider, model)`` builds a fresh usage observer
+        per attempt; only the successful attempt's observer sees a complete
+        stream, so usage is recorded exactly once.
+        """
+        gateway_model = str(payload.get("model", ""))
+        rule = self.resolve_rule(gateway_model)
+        targets = await self._ordered_targets(rule, client_key)
+
+        outcome = RouteOutcome(result=None, error=None)
+        for target in targets:
+            provider = await self._registry.get(target.provider)
+            if provider is None:
+                outcome.errors.append(
+                    f"provider {target.provider!r} unavailable")
+                continue
+
+            # Sub-provider fallback: gateway loops OpenRouter upstreams one at
+            # a time, each pinned (chat.py:158-189). Otherwise one attempt
+            # series with the whole order pinned (chat.py:137-139).
+            if target.use_provider_order_as_fallback and target.providers_order:
+                sub_orders: list[list[str] | None] = [
+                    [sub] for sub in target.providers_order]
+            else:
+                sub_orders = [None]
+
+            retries = max(0, int(target.retry_count))
+            for attempt_idx in range(retries + 1):
+                for sub_order in sub_orders:
+                    request = self._build_attempt(
+                        payload, target, target.provider, sub_order)
+                    observer = observer_factory(target.provider, target.model)
+                    outcome.attempts += 1
+                    result, error = await provider.complete(request, observer)
+                    if error is None and result is not None:
+                        outcome.result = result
+                        outcome.provider = target.provider
+                        outcome.model = target.model
+                        return outcome
+                    detail = str(error) if error else "empty response"
+                    sub = f" (upstream={sub_order[0]})" if sub_order else ""
+                    outcome.errors.append(
+                        f"{target.provider}/{target.model}{sub}: {detail}")
+                    logger.warning("attempt failed: %s", outcome.errors[-1])
+                if attempt_idx < retries and 0 < target.retry_delay < MAX_RETRY_DELAY_S:
+                    await self._sleep(target.retry_delay)
+
+        outcome.error = CompletionError(
+            detail="; ".join(outcome.errors[-5:]) or
+                   f"no providers available for {gateway_model!r}",
+            status=503, retryable=False)
+        return outcome

@@ -1,0 +1,147 @@
+"""Application composition: wire config, DBs, router, providers, HTTP app.
+
+Counterpart of the reference's ``main.py`` (app bootstrap, lifespan state,
+middleware order, router mounting, static files, ``/health``, ``/`` redirect
+— ``main.py:30-116``), built on aiohttp. One ``GatewayApp`` owns exactly one
+ConfigLoader / UsageDB / RotationDB (the reference accidentally creates
+duplicates at import time — SURVEY.md §1 "layering reality").
+"""
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Callable
+
+from aiohttp import web
+
+from ..config.loader import ConfigLoader
+from ..config.settings import Settings
+from ..db.rotation import RotationDB
+from ..db.usage import UsageDB
+from ..providers.base import Provider
+from ..routing.router import ProviderRegistry, Router
+from . import chat, config_api, models_api, stats_api
+from .middleware import (
+    auth_middleware,
+    cors_middleware,
+    request_id_header_middleware,
+    request_logging_middleware,
+)
+
+logger = logging.getLogger(__name__)
+
+STATIC_DIR = Path(__file__).resolve().parent.parent / "static"
+
+
+class GatewayApp:
+    """Holds the gateway's singletons; attached to the aiohttp app as
+    ``app["gateway"]``."""
+
+    def __init__(self, settings: Settings, loader: ConfigLoader,
+                 local_factory: Callable[..., Provider] | None = None):
+        self.settings = settings
+        self.loader = loader
+        self.usage_db = UsageDB(settings.db_dir or "db")
+        self.rotation_db = RotationDB(settings.db_dir or "db")
+        self.registry = ProviderRegistry(loader, local_factory=local_factory)
+        self.router = Router(loader, self.registry, self.rotation_db,
+                             fallback_provider=settings.fallback_provider)
+
+    async def close(self) -> None:
+        await self.registry.close()
+        self.usage_db.close()
+        self.rotation_db.close()
+
+
+async def _health(request: web.Request) -> web.Response:
+    return web.json_response({"status": "ok"})
+
+
+async def _root_redirect(request: web.Request) -> web.Response:
+    raise web.HTTPFound("/v1/ui/rules-editor")
+
+
+def _static_page(filename: str):
+    async def handler(request: web.Request) -> web.Response:
+        path = STATIC_DIR / filename
+        if not path.exists():
+            return web.json_response({"detail": f"{filename} not found"}, status=404)
+        return web.Response(text=path.read_text(), content_type="text/html")
+    return handler
+
+
+def build_app(settings: Settings | None = None,
+              loader: ConfigLoader | None = None,
+              local_factory: Callable[..., Provider] | None = None,
+              gateway: GatewayApp | None = None) -> web.Application:
+    """Build the aiohttp application. All dependencies injectable for tests."""
+    settings = settings or Settings.from_env()
+    if loader is None:
+        loader = ConfigLoader(settings.config_dir or ".",
+                              fallback_provider=settings.fallback_provider)
+    gw = gateway or GatewayApp(settings, loader, local_factory=local_factory)
+
+    app = web.Application(middlewares=[
+        cors_middleware(settings.allowed_origins),
+        request_id_header_middleware(),
+        request_logging_middleware(),
+        auth_middleware(settings.gateway_api_key),
+    ])
+    app["gateway"] = gw
+
+    app.router.add_get("/health", _health)
+    app.router.add_get("/", _root_redirect)
+
+    # Core OpenAI-compatible API
+    app.router.add_post("/v1/chat/completions", chat.chat_completions)
+    app.router.add_get("/v1/models", models_api.get_models)
+    app.router.add_get("/v1/models/AsOpenCodeFormat",
+                       models_api.get_models_as_opencode)
+    app.router.add_get("/v1/models/AsGitHubCopilotFormat",
+                       models_api.get_models_as_github_copilot)
+
+    # Config editor API (+ UI pages)
+    app.router.add_get("/v1/config/models-rules", config_api.get_rules_text)
+    app.router.add_post("/v1/config/models-rules", config_api.save_rules)
+    app.router.add_get("/v1/config/providers", config_api.get_providers_text)
+    app.router.add_post("/v1/config/providers", config_api.save_providers)
+    app.router.add_get("/v1/ui/rules-editor", _static_page("rules-editor.html"))
+    app.router.add_get("/v1/ui/usage-stats", _static_page("usage-stats.html"))
+
+    # Stats API
+    app.router.add_get("/v1/api/usage-stats/{period}", stats_api.get_usage_stats)
+    app.router.add_get("/v1/api/usage-records", stats_api.get_usage_records)
+
+    if STATIC_DIR.exists():
+        app.router.add_static("/static", STATIC_DIR)
+
+    async def _on_cleanup(app: web.Application) -> None:
+        await gw.close()
+
+    app.on_cleanup.append(_on_cleanup)
+    return app
+
+
+def run(settings: Settings | None = None) -> None:
+    settings = settings or Settings.from_env()
+    from ..utils.logging_setup import configure_logging
+    configure_logging(settings.logs_dir or "logs", settings.log_level)
+    try:
+        app = build_app(settings, local_factory=_default_local_factory())
+    except Exception as e:
+        logger.error("startup failed: %s", e)
+        raise SystemExit(1)
+    web.run_app(app, host=settings.gateway_host, port=settings.gateway_port,
+                access_log=None)
+
+
+def _default_local_factory():
+    """Lazily import the TPU engine provider factory (keeps JAX optional for
+    proxy-only deployments)."""
+    try:
+        from ..providers.local import make_local_provider
+        return make_local_provider
+    except Exception:
+        logger.warning("local TPU engine unavailable; type=local providers "
+                       "will be rejected", exc_info=True)
+        return None

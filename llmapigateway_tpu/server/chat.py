@@ -1,0 +1,86 @@
+"""POST /v1/chat/completions — the gateway's core endpoint.
+
+Thin HTTP shim over the routing engine (unlike the reference, whose handler
+contains the whole fallback loop — ``api/v1/chat.py:41-198``). Body is parsed
+as json5 for parity with the reference's lenient parsing (``chat.py:41``).
+Streaming responses are committed (200, SSE headers) only after routing has
+produced a primed stream, so upstream failures still fell back.
+"""
+from __future__ import annotations
+
+import functools
+import logging
+
+import json5
+from aiohttp import web
+
+from ..providers.base import JSONCompletion, StreamingCompletion
+from ..server.usage_capture import UsageCollector
+from .middleware import client_api_key
+
+logger = logging.getLogger(__name__)
+
+
+async def chat_completions(request: web.Request) -> web.StreamResponse:
+    gw = request.app["gateway"]
+    try:
+        body = await request.text()
+        payload = json5.loads(body)
+        if not isinstance(payload, dict):
+            raise ValueError("body must be a JSON object")
+    except Exception as e:
+        return web.json_response(
+            {"error": {"message": f"invalid request body: {e}", "code": 400}},
+            status=400)
+
+    if "model" not in payload:
+        return web.json_response(
+            {"error": {"message": "missing required field 'model'", "code": 400}},
+            status=400)
+
+    observer_factory = functools.partial(
+        _make_collector, payload=payload, gw=gw)
+
+    outcome = await gw.router.dispatch(
+        payload, client_api_key(request), observer_factory)
+
+    if outcome.error is not None or outcome.result is None:
+        detail = str(outcome.error) if outcome.error else "no providers succeeded"
+        return web.json_response(
+            {"error": {"message": f"All fallback models failed. Last error: {detail}",
+                       "code": 503, "attempts": outcome.attempts}},
+            status=503)
+
+    result = outcome.result
+    if isinstance(result, JSONCompletion):
+        return web.json_response(result.data)
+
+    assert isinstance(result, StreamingCompletion)
+    resp = web.StreamResponse(
+        status=200,
+        headers={"Content-Type": "text/event-stream",
+                 "Cache-Control": "no-cache",
+                 "X-Accel-Buffering": "no",
+                 "Connection": "keep-alive"})
+    await resp.prepare(request)
+    try:
+        async for frame in result.frames:
+            await resp.write(frame)
+        await resp.write_eof()
+    except ConnectionResetError:
+        # Client hung up mid-stream; the provider generator's finally block
+        # still fires (usage gets recorded with what was streamed).
+        logger.info("client disconnected mid-stream")
+        await result.frames.aclose()
+    return resp
+
+
+def _make_collector(provider: str, model: str, *, payload: dict, gw) -> UsageCollector:
+    settings = gw.settings
+    return UsageCollector(
+        provider=provider, model=model,
+        usage_db=gw.usage_db,
+        request_payload=payload if settings.log_chat_messages else {},
+        logs_dir=settings.logs_dir,
+        log_chat_messages=settings.log_chat_messages,
+        log_file_limit=settings.log_file_limit)

@@ -1,0 +1,63 @@
+"""Config editor API: raw-text GET/POST of the two json5 files + hot reload.
+
+Parity with the reference's rules-editor router (``api/v1/rules_editor.py``):
+raw text is served/saved verbatim so comments survive; saves are validated
+(json5 parse + pydantic + cross-checks) before the file is written — stricter
+than the reference, which writes first and can end with a saved-but-unloaded
+file (``rules_editor.py:80-92``). Validation failures return a structured
+400 ``{detail, errors}`` the editor UI renders.
+"""
+from __future__ import annotations
+
+import logging
+
+from aiohttp import web
+
+from ..config.schemas import ConfigError
+
+logger = logging.getLogger(__name__)
+
+
+async def get_rules_text(request: web.Request) -> web.Response:
+    gw = request.app["gateway"]
+    try:
+        return web.Response(text=gw.loader.read_raw("rules"),
+                            content_type="text/plain")
+    except OSError as e:
+        return web.json_response({"detail": str(e)}, status=404)
+
+
+async def get_providers_text(request: web.Request) -> web.Response:
+    gw = request.app["gateway"]
+    try:
+        return web.Response(text=gw.loader.read_raw("providers"),
+                            content_type="text/plain")
+    except OSError as e:
+        return web.json_response({"detail": str(e)}, status=404)
+
+
+async def _save(request: web.Request, which: str) -> web.Response:
+    gw = request.app["gateway"]
+    text = await request.text()
+    try:
+        gw.loader.write_raw(which, text)
+    except ConfigError as e:
+        return web.json_response(
+            {"detail": f"validation failed; file not saved", "errors": [str(e)]},
+            status=400)
+    except ValueError as e:      # json5 syntax error
+        return web.json_response(
+            {"detail": "invalid json5; file not saved", "errors": [str(e)]},
+            status=400)
+    except OSError as e:
+        return web.json_response({"detail": f"write failed: {e}"}, status=500)
+    return web.json_response({"status": "ok", "reloaded": True,
+                              "config_version": gw.loader.version})
+
+
+async def save_rules(request: web.Request) -> web.Response:
+    return await _save(request, "rules")
+
+
+async def save_providers(request: web.Request) -> web.Response:
+    return await _save(request, "providers")

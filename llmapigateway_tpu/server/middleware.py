@@ -1,0 +1,119 @@
+"""aiohttp middlewares: CORS, request logging, bearer auth.
+
+Parity targets:
+* CORS — reference wires CORSMiddleware with configured origins
+  (``main.py:69-75``).
+* Request logging — per-request UUID, method/path/client, masked headers,
+  duration + status, ``x-request-id`` response header, ``/health`` excluded
+  (``middleware/request_logging.py:17-90``).
+* Auth — bearer-token check against the gateway key. The reference *intends*
+  to guard chat completions but its path check has a typo and never matches
+  (``middleware/auth.py:17`` — ``/chat/completion`` without the final "s");
+  here the **intended** behavior is implemented: all ``/v1/*`` endpoints are
+  protected except health; open when no key is configured (``auth.py:37-42``).
+"""
+from __future__ import annotations
+
+import logging
+import time
+import uuid
+
+from aiohttp import web
+
+from ..utils.logging_setup import mask_headers
+
+logger = logging.getLogger("gateway.request")
+
+UNPROTECTED_PATHS = frozenset(("/health", "/", "/favicon.ico"))
+
+
+def cors_middleware(allowed_origins: list[str]):
+    allow_all = "*" in allowed_origins
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        origin = request.headers.get("Origin")
+        if request.method == "OPTIONS":
+            resp = web.Response(status=204)
+        else:
+            resp = await handler(request)
+        if origin and (allow_all or origin in allowed_origins):
+            resp.headers["Access-Control-Allow-Origin"] = "*" if allow_all else origin
+            resp.headers["Access-Control-Allow-Methods"] = "GET, POST, OPTIONS"
+            resp.headers["Access-Control-Allow-Headers"] = "Authorization, Content-Type"
+        return resp
+
+    return middleware
+
+
+def request_logging_middleware():
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        if request.path == "/health":
+            return await handler(request)
+        req_id = uuid.uuid4().hex[:16]
+        request["request_id"] = req_id
+        start = time.monotonic()
+        logger.info("request start", extra={
+            "request_id": req_id, "method": request.method,
+            "path": request.path, "client": request.remote,
+            "headers": mask_headers(dict(request.headers))})
+        try:
+            resp = await handler(request)
+            status = resp.status
+            return resp
+        except web.HTTPException as e:
+            status = e.status
+            raise
+        except Exception:
+            status = 500
+            raise
+        finally:
+            duration_ms = (time.monotonic() - start) * 1000.0
+            logger.info("request end", extra={
+                "request_id": req_id, "status": status,
+                "duration_ms": round(duration_ms, 2)})
+
+    return middleware
+
+
+def request_id_header_middleware():
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        resp = await handler(request)
+        req_id = request.get("request_id")
+        if req_id:
+            resp.headers["x-request-id"] = req_id
+        return resp
+
+    return middleware
+
+
+def auth_middleware(gateway_api_key: str | None):
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        if not gateway_api_key or request.path in UNPROTECTED_PATHS \
+                or request.path.startswith("/static") \
+                or request.method == "OPTIONS":
+            return await handler(request)
+        auth = request.headers.get("Authorization", "")
+        if not auth.startswith("Bearer "):
+            return web.json_response(
+                {"error": {"message": "Missing bearer token", "code": 401}},
+                status=401)
+        if auth[len("Bearer "):].strip() != gateway_api_key:
+            return web.json_response(
+                {"error": {"message": "Invalid API key", "code": 403}},
+                status=403)
+        return await handler(request)
+
+    return middleware
+
+
+def client_api_key(request: web.Request) -> str:
+    """The client's bearer token (used as the rotation identity,
+    cf. chat.py:66)."""
+    auth = request.headers.get("Authorization", "")
+    if auth.startswith("Bearer "):
+        return auth[len("Bearer "):].strip()
+    return "anonymous"

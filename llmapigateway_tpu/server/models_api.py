@@ -1,0 +1,153 @@
+"""GET /v1/models and the agent-integration format transforms.
+
+Parity with the reference's models router (``api/v1/models.py``):
+
+* ``/v1/models`` — union of gateway rule models (``owned_by: "llmgateway"``,
+  listed first) and the fallback provider's live model list; degrades
+  gracefully when the upstream fetch fails (``models.py:224-312``). Unlike
+  the reference — which snapshots rules at import time and never sees hot
+  reloads (``models.py:14-16``, SURVEY.md §1) — this reads the live loader.
+* ``/v1/models/AsOpenCodeFormat`` — opencode.json provider block: context/
+  output limits, modality remap (``file``→``pdf``), reasoning-effort
+  variants (``models.py:89-144``).
+* ``/v1/models/AsGitHubCopilotFormat`` — chatLanguageModels.json entries:
+  toolCalling always on, vision from input modalities, reasoning variants;
+  gateway-local models forced vision+reasoning (``models.py:146-222``).
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any
+
+from aiohttp import web
+
+logger = logging.getLogger(__name__)
+
+REASONING_VARIANTS = ["none", "minimal", "low", "medium", "high", "xhigh"]
+# Defaults the reference hardcodes when upstream metadata is missing.
+OPENCODE_DEFAULT_CONTEXT, OPENCODE_DEFAULT_OUTPUT = 200_000, 32_000
+COPILOT_DEFAULT_CONTEXT, COPILOT_DEFAULT_OUTPUT = 400_000, 60_000
+
+
+async def _gateway_models(gw) -> list[dict[str, Any]]:
+    created = int(time.time())
+    return [{"id": name, "object": "model", "created": created,
+             "owned_by": "llmgateway"}
+            for name in gw.loader.rules]
+
+
+async def _upstream_models(gw) -> list[dict[str, Any]]:
+    provider = await gw.registry.get(gw.settings.fallback_provider)
+    if provider is None:
+        return []
+    models = await provider.list_models()
+    return models or []
+
+
+async def get_models(request: web.Request) -> web.Response:
+    gw = request.app["gateway"]
+    gateway_models = await _gateway_models(gw)
+    include_fallback = request.query.get("includefallbackmodels", "true") \
+        .lower() != "false"
+    upstream = await _upstream_models(gw) if include_fallback else []
+    seen = {m["id"] for m in gateway_models}
+    merged = gateway_models + [m for m in upstream
+                               if isinstance(m, dict) and m.get("id") not in seen]
+    return web.json_response({"object": "list", "data": merged})
+
+
+def _extract_modalities(model: dict[str, Any]) -> tuple[list[str], list[str]]:
+    arch = model.get("architecture") or {}
+    inputs = arch.get("input_modalities") or ["text"]
+    outputs = arch.get("output_modalities") or ["text"]
+    # Reference remaps "file" → "pdf" for opencode (models.py:36-66).
+    inputs = ["pdf" if m == "file" else m for m in inputs]
+    return inputs, outputs
+
+
+def _reasoning_variants(model: dict[str, Any]) -> list[str]:
+    supported = model.get("supported_parameters") or []
+    if "reasoning" in supported or "include_reasoning" in supported:
+        return REASONING_VARIANTS
+    return []
+
+
+async def get_models_as_opencode(request: web.Request) -> web.Response:
+    gw = request.app["gateway"]
+    gateway_models = await _gateway_models(gw)
+    include_fallback = request.query.get("includefallbackmodels", "true") \
+        .lower() != "false"
+    upstream = await _upstream_models(gw) if include_fallback else []
+    upstream_by_id = {m.get("id"): m for m in upstream if isinstance(m, dict)}
+
+    models_block: dict[str, Any] = {}
+    for m in gateway_models + [u for i, u in upstream_by_id.items()
+                               if i not in {g["id"] for g in gateway_models}]:
+        mid = m["id"]
+        meta = upstream_by_id.get(mid, m)
+        top = meta.get("top_provider") or {}
+        context = top.get("context_length") or meta.get("context_length") \
+            or OPENCODE_DEFAULT_CONTEXT
+        output = top.get("max_completion_tokens") or OPENCODE_DEFAULT_OUTPUT
+        inputs, _ = _extract_modalities(meta)
+        entry: dict[str, Any] = {
+            "name": meta.get("name", mid),
+            "limit": {"context": context, "output": output},
+            "modalities": {"input": inputs, "output": ["text"]},
+        }
+        variants = _reasoning_variants(meta)
+        if variants or m.get("owned_by") == "llmgateway":
+            entry["variants"] = {
+                v: {"reasoning_effort": v} for v in (variants or REASONING_VARIANTS)
+                if v != "none"}
+        models_block[mid] = entry
+
+    host = request.host or f"localhost:{gw.settings.gateway_port}"
+    block = {
+        "llmgateway": {
+            "npm": "@ai-sdk/openai-compatible",
+            "name": "LLM Gateway (TPU)",
+            "options": {
+                "baseURL": f"http://{host}/v1",
+                "apiKey": "{env:GATEWAY_API_KEY}",
+            },
+            "models": models_block,
+        }
+    }
+    return web.json_response(block)
+
+
+async def get_models_as_github_copilot(request: web.Request) -> web.Response:
+    gw = request.app["gateway"]
+    gateway_models = await _gateway_models(gw)
+    include_fallback = request.query.get("includefallbackmodels", "true") \
+        .lower() != "false"
+    upstream = await _upstream_models(gw) if include_fallback else []
+    upstream_by_id = {m.get("id"): m for m in upstream if isinstance(m, dict)}
+    gateway_ids = {g["id"] for g in gateway_models}
+
+    out: list[dict[str, Any]] = []
+    for m in gateway_models + [u for i, u in upstream_by_id.items()
+                               if i not in gateway_ids]:
+        mid = m["id"]
+        meta = upstream_by_id.get(mid, m)
+        is_local = m.get("owned_by") == "llmgateway"
+        inputs, _ = _extract_modalities(meta)
+        vision = "image" in inputs or is_local
+        top = meta.get("top_provider") or {}
+        entry = {
+            "id": mid,
+            "name": meta.get("name", mid),
+            "toolCalling": True,
+            "vision": vision,
+            "maxInputTokens": top.get("context_length")
+                or meta.get("context_length") or COPILOT_DEFAULT_CONTEXT,
+            "maxOutputTokens": top.get("max_completion_tokens")
+                or COPILOT_DEFAULT_OUTPUT,
+        }
+        variants = _reasoning_variants(meta)
+        if variants or is_local:
+            entry["reasoningEfforts"] = [v for v in REASONING_VARIANTS if v != "none"]
+        out.append(entry)
+    return web.json_response(out)

@@ -1,0 +1,47 @@
+"""Usage statistics API.
+
+Parity with the reference's stats router (``api/v1/stats.py``):
+``/v1/api/usage-stats/{period}`` with period ∈ {hour, day, week, month} over
+windows of 24 h / 2 w / 15 w / 365 d (``stats.py:41-56``), and paginated
+``/v1/api/usage-records`` (``stats.py:65-83``). Extended with avg TTFT and
+tok/s columns from the extended usage schema.
+"""
+from __future__ import annotations
+
+import datetime as dt
+
+from aiohttp import web
+
+_WINDOWS = {
+    "hour": dt.timedelta(hours=24),
+    "day": dt.timedelta(weeks=2),
+    "week": dt.timedelta(weeks=15),
+    "month": dt.timedelta(days=365),
+}
+
+
+async def get_usage_stats(request: web.Request) -> web.Response:
+    gw = request.app["gateway"]
+    period = request.match_info["period"]
+    window = _WINDOWS.get(period)
+    if window is None:
+        return web.json_response(
+            {"detail": f"period must be one of {sorted(_WINDOWS)}"}, status=400)
+    now = dt.datetime.now()
+    start = (now - window).strftime("%Y-%m-%d %H:%M:%S")
+    end = now.strftime("%Y-%m-%d %H:%M:%S")
+    rows = await gw.usage_db.aggregated_async(period, start, end)
+    return web.json_response({"period": period, "data": rows})
+
+
+async def get_usage_records(request: web.Request) -> web.Response:
+    gw = request.app["gateway"]
+    try:
+        limit = min(200, int(request.query.get("limit", "25")))
+        offset = max(0, int(request.query.get("offset", "0")))
+    except ValueError:
+        return web.json_response({"detail": "limit/offset must be ints"}, status=400)
+    rows = await gw.usage_db.latest_async(limit, offset)
+    total = await gw.usage_db.total_count_async()
+    return web.json_response({"records": rows, "total": total,
+                              "limit": limit, "offset": offset})

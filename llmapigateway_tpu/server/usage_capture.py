@@ -1,0 +1,149 @@
+"""Per-request usage capture: transcript files + usage ledger + TTFT/tok/s.
+
+Replaces the reference's tee-middleware + re-parsing background thread
+(``middleware/chat_logging.py``): providers parse their own stream once and
+feed this observer directly (SURVEY.md §3.2 fix). Behavior kept:
+
+* per-request transcript files ``logs/YYYY-MM-DD_HH-MM-SS.mmm.txt`` with a
+  token/cost header block (``chat_logging.py:22-67``), only when
+  ``LOG_CHAT_MESSAGES`` is enabled; pruned beyond ``LOG_FILE_LIMIT``
+  (``chat_logging.py:59-65``);
+* usage extraction incl. reasoning/cached token details and cost, with
+  reasoning subtracted from completion (``chat_logging.py:233-272``);
+* ledger inserts that never break serving.
+
+Extended: wall-clock TTFT and decode tokens/sec are recorded per request —
+the BASELINE north-star metrics.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any
+
+from ..db.usage import UsageDB, UsageRecord
+
+logger = logging.getLogger(__name__)
+
+
+def extract_usage_fields(usage: dict[str, Any]) -> dict[str, Any]:
+    """Normalize an OpenAI-style usage object (cf. chat_logging.py:233-272)."""
+    prompt = int(usage.get("prompt_tokens") or 0)
+    completion = int(usage.get("completion_tokens") or 0)
+    total = int(usage.get("total_tokens") or (prompt + completion))
+    details = usage.get("completion_tokens_details") or {}
+    reasoning = int(details.get("reasoning_tokens") or
+                    usage.get("reasoning_tokens") or 0)
+    pdetails = usage.get("prompt_tokens_details") or {}
+    cached = int(pdetails.get("cached_tokens") or usage.get("cached_tokens") or 0)
+    cost = float(usage.get("cost") or usage.get("total_cost") or 0.0)
+    # Reference reports completion net of reasoning (chat_logging.py:262-263).
+    completion = max(0, completion - reasoning)
+    return {"prompt_tokens": prompt, "completion_tokens": completion,
+            "total_tokens": total, "reasoning_tokens": reasoning,
+            "cached_tokens": cached, "cost": cost}
+
+
+def write_transcript(logs_dir: Path, limit: int, request_payload: dict[str, Any],
+                     response_text: str, meta: dict[str, Any]) -> None:
+    """Write one transcript file and prune beyond `limit` (blocking; callers
+    offload to a thread)."""
+    try:
+        logs_dir.mkdir(parents=True, exist_ok=True)
+        now = time.time()
+        stamp = time.strftime("%Y-%m-%d_%H-%M-%S", time.localtime(now))
+        name = f"{stamp}.{int((now % 1) * 1000):03d}.txt"
+        lines = ["=== LLM Gateway chat transcript ==="]
+        for k, v in meta.items():
+            lines.append(f"{k}: {v}")
+        lines.append("\n--- request messages ---")
+        for msg in request_payload.get("messages", []) or []:
+            role = msg.get("role", "?") if isinstance(msg, dict) else "?"
+            content = msg.get("content", "") if isinstance(msg, dict) else str(msg)
+            lines.append(f"[{role}] {content}")
+        lines.append("\n--- assistant response ---")
+        lines.append(response_text)
+        (logs_dir / name).write_text("\n".join(lines))
+        # Prune oldest transcripts beyond the cap (chat_logging.py:59-65).
+        transcripts = sorted(p for p in logs_dir.glob("*.txt"))
+        for p in transcripts[:-limit] if limit > 0 else []:
+            p.unlink(missing_ok=True)
+    except OSError:
+        logger.exception("transcript write failed (ignored)")
+
+
+@dataclass
+class UsageCollector:
+    """One attempt's observer. Only a completed stream records usage."""
+    provider: str
+    model: str
+    usage_db: UsageDB | None = None
+    request_payload: dict[str, Any] = field(default_factory=dict)
+    logs_dir: Path | None = None
+    log_chat_messages: bool = False
+    log_file_limit: int = 15
+    loop: asyncio.AbstractEventLoop | None = None
+
+    _t_start: float = field(default_factory=time.monotonic)
+    _t_first: float | None = None
+    _t_end: float | None = None
+    _text: list[str] = field(default_factory=list)
+    _usage: dict[str, Any] | None = None
+    _ended: bool = False
+
+    # -- observer protocol ----------------------------------------------------
+    def on_first_token(self) -> None:
+        if self._t_first is None:
+            self._t_first = time.monotonic()
+
+    def on_content_delta(self, text: str) -> None:
+        if text:
+            self._text.append(text)
+
+    def on_usage(self, usage: dict[str, Any]) -> None:
+        self._usage = usage
+
+    def on_stream_end(self, error: str | None = None) -> None:
+        if self._ended:
+            return
+        self._ended = True
+        self._t_end = time.monotonic()
+        try:
+            self._record(error)
+        except Exception:
+            logger.exception("usage record failed (ignored)")
+
+    # -- recording ------------------------------------------------------------
+    @property
+    def ttft_ms(self) -> float | None:
+        if self._t_first is None:
+            return None
+        return (self._t_first - self._t_start) * 1000.0
+
+    def _record(self, error: str | None) -> None:
+        fields = extract_usage_fields(self._usage or {})
+        completion_tokens = fields["completion_tokens"] + fields["reasoning_tokens"]
+        tps = None
+        if self._t_first is not None and self._t_end is not None \
+                and completion_tokens > 1 and self._t_end > self._t_first:
+            tps = (completion_tokens - 1) / (self._t_end - self._t_first)
+
+        rec = UsageRecord(model=self.model, provider=self.provider,
+                          ttft_ms=self.ttft_ms, tokens_per_sec=tps, **fields)
+        if self.usage_db is not None and (self._usage or self._text):
+            self.usage_db.insert(rec)
+
+        if self.log_chat_messages and self.logs_dir is not None:
+            meta = {"provider": self.provider, "model": self.model,
+                    "prompt_tokens": fields["prompt_tokens"],
+                    "completion_tokens": fields["completion_tokens"],
+                    "reasoning_tokens": fields["reasoning_tokens"],
+                    "cached_tokens": fields["cached_tokens"],
+                    "cost": fields["cost"],
+                    "ttft_ms": self.ttft_ms, "tokens_per_sec": tps,
+                    "error": error or ""}
+            write_transcript(self.logs_dir, self.log_file_limit,
+                             self.request_payload, "".join(self._text), meta)

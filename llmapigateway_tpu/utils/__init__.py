@@ -1,0 +1,4 @@
+from .logging_setup import configure_logging
+from .sse import SSEParser, format_sse, SSE_DONE
+
+__all__ = ["configure_logging", "SSEParser", "format_sse", "SSE_DONE"]

@@ -1,0 +1,87 @@
+"""Structured JSON logging to console + rotating file.
+
+Parity with the reference's ``configure_logging``
+(``llm_gateway_core/utils/logging_setup.py:14-54``): JSON lines, console +
+256 KB x 5 rotating file, noisy HTTP libraries demoted to WARNING. Implemented
+on stdlib only (no python-json-logger dependency).
+"""
+from __future__ import annotations
+
+import json
+import logging
+import logging.handlers
+import time
+from pathlib import Path
+
+_LOG_MAX_BYTES = 256 * 1024
+_LOG_BACKUPS = 5
+
+
+class JsonFormatter(logging.Formatter):
+    """One JSON object per line; includes any `extra` fields."""
+
+    _SKIP = frozenset(
+        "name msg args levelname levelno pathname filename module exc_info "
+        "exc_text stack_info lineno funcName created msecs relativeCreated "
+        "thread threadName processName process taskName message".split())
+
+    def format(self, record: logging.LogRecord) -> str:
+        out = {
+            "ts": time.strftime("%Y-%m-%dT%H:%M:%S", time.localtime(record.created))
+                  + f".{int(record.msecs):03d}",
+            "level": record.levelname,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        for key, val in record.__dict__.items():
+            if key not in self._SKIP and not key.startswith("_"):
+                try:
+                    json.dumps(val)
+                    out[key] = val
+                except (TypeError, ValueError):
+                    out[key] = repr(val)
+        if record.exc_info:
+            out["exc"] = self.formatException(record.exc_info)
+        return json.dumps(out, ensure_ascii=False)
+
+
+def configure_logging(logs_dir: Path | str = "logs", level: str = "INFO") -> None:
+    logs_path = Path(logs_dir)
+    logs_path.mkdir(parents=True, exist_ok=True)
+
+    root = logging.getLogger()
+    root.setLevel(getattr(logging, level.upper(), logging.INFO))
+    # Idempotent: replace our handlers on re-configure instead of stacking.
+    for h in list(root.handlers):
+        if getattr(h, "_llmgw", False):
+            root.removeHandler(h)
+
+    fmt = JsonFormatter()
+    console = logging.StreamHandler()
+    console.setFormatter(fmt)
+    console._llmgw = True  # type: ignore[attr-defined]
+    root.addHandler(console)
+
+    filehandler = logging.handlers.RotatingFileHandler(
+        logs_path / "gateway.log", maxBytes=_LOG_MAX_BYTES, backupCount=_LOG_BACKUPS)
+    filehandler.setFormatter(fmt)
+    filehandler._llmgw = True  # type: ignore[attr-defined]
+    root.addHandler(filehandler)
+
+    for noisy in ("httpcore", "httpx", "aiohttp.access", "jax", "urllib3"):
+        logging.getLogger(noisy).setLevel(logging.WARNING)
+
+
+SENSITIVE_HEADERS = frozenset(
+    ("authorization", "api-key", "x-api-key", "proxy-authorization", "cookie"))
+
+
+def mask_headers(headers: dict[str, str]) -> dict[str, str]:
+    """Mask secret-bearing headers for logs (cf. request_logging.py:37-45)."""
+    out = {}
+    for k, v in headers.items():
+        if k.lower() in SENSITIVE_HEADERS and v:
+            out[k] = v[:12] + "****" if len(v) > 16 else "****"
+        else:
+            out[k] = v
+    return out

@@ -1,0 +1,67 @@
+"""Test configuration.
+
+JAX runs on 8 virtual CPU devices (the standard trick for exercising
+multi-chip mesh/collective code without TPU hardware — SURVEY.md §4c). Must
+be set before any jax import, hence here at conftest import time.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import asyncio          # noqa: E402
+import inspect          # noqa: E402
+from pathlib import Path  # noqa: E402
+
+import pytest           # noqa: E402
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    """Run ``async def`` tests via asyncio.run (no pytest-asyncio here)."""
+    func = pyfuncitem.obj
+    if inspect.iscoroutinefunction(func):
+        kwargs = {name: pyfuncitem.funcargs[name]
+                  for name in pyfuncitem._fixtureinfo.argnames}
+        asyncio.run(func(**kwargs))
+        return True
+    return None
+
+
+PROVIDERS_JSON5 = """\
+[
+    // comments must survive round-trips
+    { "fakeup": { "baseUrl": "http://127.0.0.1:1/v1", "apikey": "FAKE_KEY_ENV" } },
+    { "openrouter": { "baseUrl": "http://127.0.0.1:1/v1", "apikey": "sk-or-literal" } },
+]
+"""
+
+RULES_JSON5 = """\
+[
+    {
+        "gateway_model_name": "gw/test-model",
+        "rotate_models": "false",
+        "fallback_models": [
+            { "provider": "fakeup", "model": "real-model-a", "retry_count": 1, "retry_delay": 0.01 },
+            { "provider": "openrouter", "model": "real-model-b" },
+        ],
+    },
+    {
+        "gateway_model_name": "gw/rotating",
+        "rotate_models": true,
+        "fallback_models": [
+            { "provider": "fakeup", "model": "rot-a" },
+            { "provider": "fakeup", "model": "rot-b" },
+            { "provider": "fakeup", "model": "rot-c" },
+        ],
+    },
+]
+"""
+
+
+@pytest.fixture
+def config_dir(tmp_path: Path) -> Path:
+    (tmp_path / "providers.json").write_text(PROVIDERS_JSON5)
+    (tmp_path / "models_fallback_rules.json").write_text(RULES_JSON5)
+    return tmp_path

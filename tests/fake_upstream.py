@@ -1,0 +1,130 @@
+"""A fake OpenAI-compatible upstream provider for integration tests.
+
+The single most valuable test asset the reference lacks (SURVEY.md §4):
+an in-process aiohttp server speaking ``/chat/completions`` (streaming and
+non-streaming) and ``/models``, with injectable fault behaviors:
+
+* fail the next N requests with an HTTP status;
+* return HTTP 200 whose SSE body carries an in-band error frame (the case
+  first-frame priming exists for);
+* emit an error frame mid-stream after some healthy chunks;
+* omit the usage object;
+* arbitrary response delay.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import dataclass, field
+from typing import Any
+
+from aiohttp import web
+
+
+@dataclass
+class FaultPlan:
+    fail_next: int = 0                 # fail this many requests with fail_status
+    fail_status: int = 500
+    inband_error_next: int = 0         # HTTP 200 + SSE error frame as first frame
+    midstream_error_after: int | None = None   # healthy chunks, then error frame
+    omit_usage: bool = False
+    delay_s: float = 0.0
+    tokens: list[str] = field(default_factory=lambda: ["Hello", " ", "world", "!"])
+
+
+class FakeUpstream:
+    """aiohttp app + request log; mount with aiohttp_server fixture."""
+
+    def __init__(self) -> None:
+        self.plan = FaultPlan()
+        self.requests: list[dict[str, Any]] = []    # captured payloads
+        self.headers_seen: list[dict[str, str]] = []
+        self.app = web.Application()
+        self.app.router.add_post("/v1/chat/completions", self._chat)
+        self.app.router.add_get("/v1/models", self._models)
+
+    def _chunk(self, i: int, text: str, model: str) -> dict[str, Any]:
+        return {"id": f"chatcmpl-fake-{i}", "object": "chat.completion.chunk",
+                "model": model,
+                "choices": [{"index": 0, "delta": {"content": text},
+                             "finish_reason": None}]}
+
+    async def _chat(self, request: web.Request) -> web.StreamResponse:
+        payload = await request.json()
+        self.requests.append(payload)
+        self.headers_seen.append(dict(request.headers))
+        plan = self.plan
+        if plan.delay_s:
+            await asyncio.sleep(plan.delay_s)
+
+        if plan.fail_next > 0:
+            plan.fail_next -= 1
+            return web.json_response(
+                {"error": {"message": "injected upstream failure",
+                           "code": plan.fail_status}},
+                status=plan.fail_status)
+
+        model = payload.get("model", "fake-model")
+        usage = {"prompt_tokens": 7, "completion_tokens": len(plan.tokens),
+                 "total_tokens": 7 + len(plan.tokens), "cost": 0.00042}
+
+        if not payload.get("stream"):
+            if plan.inband_error_next > 0:
+                plan.inband_error_next -= 1
+                return web.json_response(
+                    {"error": {"message": "in-band non-streaming error"}})
+            body = {"id": "chatcmpl-fake", "object": "chat.completion",
+                    "model": model,
+                    "choices": [{"index": 0,
+                                 "message": {"role": "assistant",
+                                             "content": "".join(plan.tokens)},
+                                 "finish_reason": "stop"}]}
+            if not plan.omit_usage:
+                body["usage"] = usage
+            return web.json_response(body)
+
+        resp = web.StreamResponse(
+            status=200, headers={"Content-Type": "text/event-stream"})
+        await resp.prepare(request)
+
+        async def send(obj: Any) -> None:
+            data = obj if isinstance(obj, str) else json.dumps(obj)
+            await resp.write(f"data: {data}\n\n".encode())
+
+        if plan.inband_error_next > 0:
+            plan.inband_error_next -= 1
+            await send({"error": {"message": "in-band streaming error",
+                                  "code": 429}})
+            await resp.write_eof()
+            return resp
+
+        for i, tok in enumerate(plan.tokens):
+            if plan.midstream_error_after is not None \
+                    and i == plan.midstream_error_after:
+                await send({"error": {"message": "midstream failure"},
+                            "code": 502})
+                await resp.write_eof()
+                return resp
+            await send(self._chunk(i, tok, model))
+        final = {"id": "chatcmpl-fake-final", "object": "chat.completion.chunk",
+                 "model": model,
+                 "choices": [{"index": 0, "delta": {},
+                              "finish_reason": "stop"}]}
+        if not plan.omit_usage:
+            final["usage"] = usage
+        await send(final)
+        await send("[DONE]")
+        await resp.write_eof()
+        return resp
+
+    async def _models(self, request: web.Request) -> web.Response:
+        return web.json_response({"object": "list", "data": [
+            {"id": "fake-model-1", "object": "model", "owned_by": "fake",
+             "context_length": 8192,
+             "architecture": {"input_modalities": ["text", "image"],
+                              "output_modalities": ["text"]},
+             "supported_parameters": ["reasoning"],
+             "top_provider": {"context_length": 8192,
+                              "max_completion_tokens": 2048}},
+            {"id": "fake-model-2", "object": "model", "owned_by": "fake"},
+        ]})

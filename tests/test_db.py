@@ -1,0 +1,59 @@
+"""Rotation + usage DB tests (parity semantics from SURVEY.md §2a)."""
+from llmapigateway_tpu.db.rotation import RotationDB
+from llmapigateway_tpu.db.usage import UsageDB, UsageRecord
+
+
+def test_rotation_first_use_is_zero_then_advances(tmp_path):
+    db = RotationDB(tmp_path)
+    assert db.next_index("key1", "gw/m", 3) == 0     # first use
+    assert db.next_index("key1", "gw/m", 3) == 1
+    assert db.next_index("key1", "gw/m", 3) == 2
+    assert db.next_index("key1", "gw/m", 3) == 0     # wraps
+    # Independent per (key, model)
+    assert db.next_index("key2", "gw/m", 3) == 0
+    assert db.next_index("key1", "gw/other", 3) == 0
+    db.close()
+
+
+def test_rotation_survives_reopen(tmp_path):
+    db = RotationDB(tmp_path)
+    db.next_index("k", "m", 4)       # 0
+    db.next_index("k", "m", 4)       # 1
+    db.close()
+    db2 = RotationDB(tmp_path)
+    assert db2.next_index("k", "m", 4) == 2
+    db2.close()
+
+
+def test_usage_insert_aggregate_latest(tmp_path):
+    db = UsageDB(tmp_path)
+    for i in range(5):
+        db.insert(UsageRecord(model="m1", provider="p", prompt_tokens=10,
+                              completion_tokens=20, total_tokens=30,
+                              cost=0.01, ttft_ms=150.0, tokens_per_sec=42.0))
+    db.insert(UsageRecord(model="m2", provider="p", prompt_tokens=1,
+                          completion_tokens=2, total_tokens=3))
+    assert db.total_count() == 6
+    latest = db.latest(limit=3)
+    assert len(latest) == 3 and latest[0]["model"] == "m2"
+    rows = db.aggregated("day", "2000-01-01", "2100-01-01")
+    by_model = {r["model"]: r for r in rows}
+    assert by_model["m1"]["total_tokens"] == 150
+    assert by_model["m1"]["requests"] == 5
+    assert abs(by_model["m1"]["avg_ttft_ms"] - 150.0) < 1e-6
+    db.close()
+
+
+def test_usage_cleanup(tmp_path):
+    db = UsageDB(tmp_path)
+    db.insert(UsageRecord(model="old", timestamp="2001-01-01 00:00:00"))
+    db.insert(UsageRecord(model="new"))
+    assert db.cleanup_old_records(days=180) == 1
+    assert db.total_count() == 1
+    db.close()
+
+
+def test_usage_insert_never_raises(tmp_path):
+    db = UsageDB(tmp_path)
+    db.close()
+    db.insert(UsageRecord(model="x"))    # closed DB → logged, not raised

@@ -1,0 +1,175 @@
+"""Routing engine tests: fallback, retry, rotation, payload injection."""
+import pytest
+
+from llmapigateway_tpu.config.loader import ConfigLoader
+from llmapigateway_tpu.db.rotation import RotationDB
+from llmapigateway_tpu.providers.base import (
+    CompletionError, CompletionRequest, JSONCompletion, NullUsageObserver, Provider)
+from llmapigateway_tpu.routing.router import ProviderRegistry, Router
+
+
+class ScriptedProvider(Provider):
+    """Fails `fail_first` times, then succeeds; records every request."""
+
+    def __init__(self, name: str, fail_first: int = 0):
+        self.name = name
+        self.fail_first = fail_first
+        self.calls: list[CompletionRequest] = []
+
+    async def complete(self, request, observer):
+        self.calls.append(request)
+        if self.fail_first > 0:
+            self.fail_first -= 1
+            return None, CompletionError("scripted failure", status=500)
+        observer.on_first_token()
+        observer.on_stream_end()
+        return JSONCompletion(data={"ok": True, "model": request.payload["model"]},
+                              provider=self.name), None
+
+
+class StubRegistry:
+    def __init__(self, providers: dict[str, Provider]):
+        self.providers = providers
+
+    async def get(self, name):
+        return self.providers.get(name)
+
+
+def make_router(config_dir, tmp_path, providers, sleeps=None):
+    loader = ConfigLoader(config_dir, fallback_provider="openrouter")
+    rotation = RotationDB(tmp_path / "rotdb")
+    recorded = sleeps if sleeps is not None else []
+
+    async def fake_sleep(s):
+        recorded.append(s)
+
+    return Router(loader, StubRegistry(providers), rotation,
+                  fallback_provider="openrouter", sleep=fake_sleep)
+
+
+def observer_factory(provider, model):
+    return NullUsageObserver()
+
+
+async def test_fallback_to_second_model(config_dir, tmp_path):
+    p1 = ScriptedProvider("fakeup", fail_first=99)    # always fails
+    p2 = ScriptedProvider("openrouter")
+    router = make_router(config_dir, tmp_path,
+                         {"fakeup": p1, "openrouter": p2})
+    outcome = await router.dispatch({"model": "gw/test-model",
+                                     "messages": [{"role": "user", "content": "hi"}]},
+                                    "client-key", observer_factory)
+    assert outcome.error is None
+    assert outcome.provider == "openrouter" and outcome.model == "real-model-b"
+    # fakeup tried retry_count=1 → 2 attempts, then openrouter succeeded.
+    assert len(p1.calls) == 2 and len(p2.calls) == 1
+    assert outcome.attempts == 3
+
+
+async def test_retry_then_success_with_delay(config_dir, tmp_path):
+    sleeps = []
+    p1 = ScriptedProvider("fakeup", fail_first=1)     # fail once, then succeed
+    router = make_router(config_dir, tmp_path,
+                         {"fakeup": p1, "openrouter": ScriptedProvider("openrouter")},
+                         sleeps=sleeps)
+    outcome = await router.dispatch({"model": "gw/test-model", "messages": []},
+                                    "k", observer_factory)
+    assert outcome.provider == "fakeup" and outcome.model == "real-model-a"
+    assert sleeps == [pytest.approx(0.01)]            # retry_delay honored
+
+
+async def test_all_fail_gives_503(config_dir, tmp_path):
+    router = make_router(config_dir, tmp_path,
+                         {"fakeup": ScriptedProvider("fakeup", fail_first=99),
+                          "openrouter": ScriptedProvider("openrouter", fail_first=99)})
+    outcome = await router.dispatch({"model": "gw/test-model", "messages": []},
+                                    "k", observer_factory)
+    assert outcome.result is None
+    assert outcome.error is not None and outcome.error.status == 503
+    assert "scripted failure" in outcome.error.detail
+
+
+async def test_unknown_model_passthrough_to_fallback_provider(config_dir, tmp_path):
+    por = ScriptedProvider("openrouter")
+    router = make_router(config_dir, tmp_path, {"openrouter": por})
+    outcome = await router.dispatch({"model": "vendor/unknown-model",
+                                     "messages": []}, "k", observer_factory)
+    assert outcome.error is None
+    # Model name passes through unchanged (chat.py:48-59 behavior).
+    assert por.calls[0].payload["model"] == "vendor/unknown-model"
+
+
+async def test_rotation_round_robin(config_dir, tmp_path):
+    p = ScriptedProvider("fakeup")
+    router = make_router(config_dir, tmp_path, {"fakeup": p})
+    models = []
+    for _ in range(4):
+        out = await router.dispatch({"model": "gw/rotating", "messages": []},
+                                    "same-key", observer_factory)
+        models.append(out.model)
+    # First use → index 0; then advances circularly.
+    assert models == ["rot-a", "rot-b", "rot-c", "rot-a"]
+
+
+async def test_payload_not_mutated_between_attempts(config_dir, tmp_path):
+    """Deliberate divergence from the reference's '<REMOVED>' mutation quirk
+    (chat.py:150): every retry must carry the real messages."""
+    p1 = ScriptedProvider("fakeup", fail_first=2)
+    p2 = ScriptedProvider("openrouter")
+    router = make_router(config_dir, tmp_path, {"fakeup": p1, "openrouter": p2})
+    payload = {"model": "gw/test-model",
+               "messages": [{"role": "user", "content": "precious"}]}
+    await router.dispatch(payload, "k", observer_factory)
+    for call in p1.calls + p2.calls:
+        assert call.payload["messages"] == [{"role": "user", "content": "precious"}]
+    assert payload["model"] == "gw/test-model"      # caller's payload untouched
+
+
+async def test_openrouter_injections(config_dir, tmp_path):
+    por = ScriptedProvider("openrouter")
+    router = make_router(config_dir, tmp_path, {"openrouter": por})
+    await router.dispatch({"model": "unknown", "messages": []}, "k",
+                          observer_factory)
+    payload = por.calls[0].payload
+    assert payload["usage"] == {"include": True}     # chat.py:114-115
+    headers = por.calls[0].extra_headers
+    assert "HTTP-Referer" in headers and "X-Title" in headers
+
+
+async def test_custom_params_headers_and_provider_order(tmp_path):
+    (tmp_path / "providers.json").write_text(
+        '[{"openrouter": {"baseUrl": "http://x", "apikey": "K"}}]')
+    (tmp_path / "models_fallback_rules.json").write_text("""[
+      {"gateway_model_name": "gw/custom", "fallback_models": [
+        {"provider": "openrouter", "model": "m",
+         "providers_order": ["SubA", "SubB"],
+         "custom_body_params": {"temperature": 0.2, "reasoning": {"effort": "high"}},
+         "custom_headers": {"X-Custom": "yes"}}]}]""")
+    por = ScriptedProvider("openrouter")
+    router = make_router(tmp_path, tmp_path, {"openrouter": por})
+    await router.dispatch({"model": "gw/custom", "messages": []}, "k",
+                          observer_factory)
+    payload = por.calls[0].payload
+    assert payload["provider"] == {"order": ["SubA", "SubB"],
+                                   "allow_fallbacks": False}
+    assert payload["temperature"] == 0.2
+    assert payload["reasoning"] == {"effort": "high"}
+    assert por.calls[0].extra_headers["X-Custom"] == "yes"
+
+
+async def test_use_provider_order_as_fallback(tmp_path):
+    """Sub-provider loop: each upstream pinned one at a time (chat.py:158-189)."""
+    (tmp_path / "providers.json").write_text(
+        '[{"openrouter": {"baseUrl": "http://x", "apikey": "K"}}]')
+    (tmp_path / "models_fallback_rules.json").write_text("""[
+      {"gateway_model_name": "gw/sub", "fallback_models": [
+        {"provider": "openrouter", "model": "m",
+         "use_provider_order_as_fallback": true,
+         "providers_order": ["SubA", "SubB", "SubC"]}]}]""")
+    por = ScriptedProvider("openrouter", fail_first=2)   # SubA, SubB fail
+    router = make_router(tmp_path, tmp_path, {"openrouter": por})
+    outcome = await router.dispatch({"model": "gw/sub", "messages": []}, "k",
+                                    observer_factory)
+    assert outcome.error is None
+    orders = [c.payload["provider"]["order"] for c in por.calls]
+    assert orders == [["SubA"], ["SubB"], ["SubC"]]

@@ -1,0 +1,66 @@
+"""SSE parser unit tests: partial frames, CRLF, multi-data, error sniffing."""
+from llmapigateway_tpu.utils.sse import SSEParser, format_sse, frame_error_detail
+
+
+def collect(parser, chunks):
+    frames = []
+    for c in chunks:
+        frames.extend(parser.feed(c))
+    return frames
+
+
+def test_basic_frames():
+    p = SSEParser()
+    frames = collect(p, [b'data: {"a": 1}\n\ndata: [DONE]\n\n'])
+    assert len(frames) == 2
+    assert frames[0].json == {"a": 1}
+    assert frames[1].is_done
+
+
+def test_partial_frame_buffering():
+    p = SSEParser()
+    assert collect(p, [b'data: {"a"']) == []
+    frames = collect(p, [b': 1}\n', b'\n'])
+    assert len(frames) == 1 and frames[0].json == {"a": 1}
+
+
+def test_crlf_delimiters():
+    p = SSEParser()
+    frames = collect(p, [b'data: {"x": 2}\r\n\r\n'])
+    assert len(frames) == 1 and frames[0].json == {"x": 2}
+
+
+def test_multi_data_lines_joined():
+    p = SSEParser()
+    frames = collect(p, [b'data: line1\ndata: line2\n\n'])
+    assert frames[0].data == "line1\nline2"
+
+
+def test_comments_and_events_ignored():
+    p = SSEParser()
+    frames = collect(p, [b': keep-alive\n\nevent: ping\n\ndata: {"k": 3}\n\n'])
+    assert len(frames) == 1 and frames[0].json == {"k": 3}
+
+
+def test_flush_unterminated():
+    p = SSEParser()
+    assert collect(p, [b'data: {"tail": true}']) == []
+    frames = list(p.flush())
+    assert len(frames) == 1 and frames[0].json == {"tail": True}
+
+
+def test_format_sse_roundtrip():
+    p = SSEParser()
+    frames = collect(p, [format_sse({"model": "m", "choices": []})])
+    assert frames[0].json == {"model": "m", "choices": []}
+
+
+def test_error_detection():
+    assert frame_error_detail({"error": {"message": "boom"}}) == "boom"
+    assert frame_error_detail({"error": "plain"}) == "plain"
+    assert frame_error_detail({"detail": "denied"}) == "denied"
+    assert "502" in frame_error_detail({"code": 502})
+    # Healthy frames are not errors even with extra keys.
+    assert frame_error_detail({"id": "x", "choices": [{}]}) is None
+    assert frame_error_detail({"choices": [], "code": 1}) is None
+    assert frame_error_detail("not a dict") is None
