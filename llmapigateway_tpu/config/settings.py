@@ -55,6 +55,7 @@ class Settings:
     allowed_origins: list[str] = field(default_factory=lambda: ["*"])
     log_file_limit: int = 15
     log_chat_messages: bool = False
+    usage_retention_days: int = 180
     log_level: str = "INFO"
     debug_mode: bool = False
     # Directories (relative to base_dir unless absolute)
@@ -84,6 +85,7 @@ class Settings:
             gateway_port=int(merged.get("GATEWAY_PORT", "9100")),
             allowed_origins=origins,
             log_file_limit=int(merged.get("LOG_FILE_LIMIT", "15")),
+            usage_retention_days=int(merged.get("USAGE_RETENTION_DAYS", "180")),
             log_chat_messages=_as_bool(merged.get("LOG_CHAT_MESSAGES"), False),
             log_level=merged.get("LOG_LEVEL", "INFO").upper(),
             debug_mode=_as_bool(merged.get("DEBUG_MODE"), False),

@@ -100,9 +100,10 @@ class UsageDB:
         """Delete rows older than `days`; returns count removed."""
         try:
             with self._lock:
+                # Rows are stamped in local time; compare in local time too.
                 cur = self._conn.execute(
                     "DELETE FROM tokens_usage WHERE timestamp < "
-                    "datetime('now', ?)", (f"-{int(days)} days",))
+                    "datetime('now', 'localtime', ?)", (f"-{int(days)} days",))
                 self._conn.commit()
                 return cur.rowcount
         except sqlite3.Error:

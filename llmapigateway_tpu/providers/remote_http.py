@@ -22,7 +22,7 @@ from typing import Any, AsyncIterator
 
 import httpx
 
-from ..utils.sse import SSEParser, format_sse, frame_error_detail
+from ..utils.sse import SSE_DONE, SSEParser, format_sse, frame_error_detail
 from .base import (
     CompletionError,
     CompletionRequest,
@@ -132,17 +132,23 @@ class RemoteHTTPProvider(Provider):
         primed: list[bytes] = []           # frames to re-emit once committed
         byte_iter = resp.aiter_bytes()
         committed = False
+        finished = False                   # [DONE] already seen during priming
         try:
             async for chunk in byte_iter:
                 for frame in parser.feed(chunk):
                     if frame.is_done:
+                        if committed:
+                            # Tiny response: data + [DONE] in one chunk.
+                            primed.append(format_sse(SSE_DONE))
+                            finished = True
+                            break
                         # Stream ended before any content: treat as error.
                         await resp.aclose()
                         return None, CompletionError(
                             f"{self.name} stream ended with no data")
                     obj = frame.json
                     detail = frame_error_detail(obj) if obj is not None else None
-                    if detail is not None:
+                    if detail is not None and not committed:
                         await resp.aclose()
                         return None, CompletionError(detail)
                     if obj is None:
@@ -165,19 +171,22 @@ class RemoteHTTPProvider(Provider):
             await resp.aclose()
             return None, CompletionError(f"stream setup failed: {e}")
 
-        frames = self._relay(resp, byte_iter, parser, primed, observer)
+        frames = self._relay(resp, byte_iter, parser, primed, observer,
+                             finished=finished)
         return StreamingCompletion(frames=frames, provider=self.name,
                                    model=str(payload.get("model", ""))), None
 
     async def _relay(self, resp: httpx.Response, byte_iter: AsyncIterator[bytes],
                      parser: SSEParser, primed: list[bytes],
-                     observer: UsageObserver) -> AsyncIterator[bytes]:
+                     observer: UsageObserver, finished: bool = False) -> AsyncIterator[bytes]:
         """Yield primed frames then the rest of the stream, watching for
         mid-stream errors and usage (request_handler.py:102-146)."""
         error: str | None = None
         try:
             for frame_bytes in primed:
                 yield frame_bytes
+            if finished:
+                return
             async for chunk in byte_iter:
                 for frame in parser.feed(chunk):
                     if frame.is_done:

@@ -59,12 +59,17 @@ class ProviderRegistry:
     run (and be tested) without importing JAX.
     """
 
+    # Grace period before closing a reconfigured provider's pooled client:
+    # must outlive the longest possible in-flight request (300 s timeout).
+    RETIRE_AFTER_S = 330.0
+
     def __init__(self, loader: ConfigLoader,
                  local_factory: Callable[[str, ProviderDetails], Provider] | None = None):
         self._loader = loader
         self._local_factory = local_factory
         self._cache: dict[str, tuple[str, Provider]] = {}   # name -> (fingerprint, provider)
         self._lock = asyncio.Lock()
+        self._retiring: set[asyncio.Task] = set()
 
     async def get(self, name: str) -> Provider | None:
         details = self._loader.providers.get(name)
@@ -76,11 +81,26 @@ class ProviderRegistry:
             if cached and cached[0] == fingerprint:
                 return cached[1]
             if cached:
-                await cached[1].close()
+                # Config changed: in-flight streams may still hold the old
+                # provider's pooled client — close it only after they can
+                # possibly have finished.
+                self._retire(cached[1])
             provider = self._build(name, details)
             if provider is not None:
                 self._cache[name] = (fingerprint, provider)
             return provider
+
+    def _retire(self, provider: Provider) -> None:
+        async def _close_later() -> None:
+            try:
+                await asyncio.sleep(self.RETIRE_AFTER_S)
+                await provider.close()
+            except asyncio.CancelledError:
+                await provider.close()
+                raise
+        task = asyncio.get_running_loop().create_task(_close_later())
+        self._retiring.add(task)
+        task.add_done_callback(self._retiring.discard)
 
     def _build(self, name: str, details: ProviderDetails) -> Provider | None:
         if details.type == "local":
@@ -95,6 +115,12 @@ class ProviderRegistry:
 
     async def close(self) -> None:
         async with self._lock:
+            for task in list(self._retiring):
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
             for _, provider in self._cache.values():
                 await provider.close()
             self._cache.clear()

@@ -115,9 +115,28 @@ def build_app(settings: Settings | None = None,
     if STATIC_DIR.exists():
         app.router.add_static("/static", STATIC_DIR)
 
+    async def _on_startup(app: web.Application) -> None:
+        # Daily retention sweep — the reference defines a 180-day cleanup but
+        # never calls it (tokens_usage_db.py:164); here it's actually wired.
+        import asyncio
+
+        async def _retention_loop() -> None:
+            while True:
+                removed = await asyncio.to_thread(
+                    gw.usage_db.cleanup_old_records, settings.usage_retention_days)
+                if removed:
+                    logger.info("usage retention: removed %d old rows", removed)
+                await asyncio.sleep(24 * 3600)
+        app["retention_task"] = asyncio.get_running_loop().create_task(
+            _retention_loop())
+
     async def _on_cleanup(app: web.Application) -> None:
+        task = app.get("retention_task")
+        if task:
+            task.cancel()
         await gw.close()
 
+    app.on_startup.append(_on_startup)
     app.on_cleanup.append(_on_cleanup)
     return app
 

@@ -56,12 +56,14 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
         return web.json_response(result.data)
 
     assert isinstance(result, StreamingCompletion)
-    resp = web.StreamResponse(
-        status=200,
-        headers={"Content-Type": "text/event-stream",
-                 "Cache-Control": "no-cache",
-                 "X-Accel-Buffering": "no",
-                 "Connection": "keep-alive"})
+    headers = {"Content-Type": "text/event-stream",
+               "Cache-Control": "no-cache",
+               "X-Accel-Buffering": "no",
+               "Connection": "keep-alive"}
+    # Prepared responses bypass the header middleware; attach the id here.
+    if request.get("request_id"):
+        headers["x-request-id"] = request["request_id"]
+    resp = web.StreamResponse(status=200, headers=headers)
     await resp.prepare(request)
     try:
         async for frame in result.frames:

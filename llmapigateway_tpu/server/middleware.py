@@ -82,7 +82,9 @@ def request_id_header_middleware():
     async def middleware(request: web.Request, handler):
         resp = await handler(request)
         req_id = request.get("request_id")
-        if req_id:
+        # Streaming responses are already prepared (headers on the wire) by
+        # the time the handler returns; those set the header themselves.
+        if req_id and not resp.prepared:
             resp.headers["x-request-id"] = req_id
         return resp
 
@@ -92,8 +94,13 @@ def request_id_header_middleware():
 def auth_middleware(gateway_api_key: str | None):
     @web.middleware
     async def middleware(request: web.Request, handler):
+        # UI pages (/v1/ui/*) are plain HTML a browser navigates to directly —
+        # it cannot attach a Bearer header, so they stay open; the data APIs
+        # they call (/v1/api/*, /v1/config/*) remain protected and the pages'
+        # JS sends the key the operator enters.
         if not gateway_api_key or request.path in UNPROTECTED_PATHS \
                 or request.path.startswith("/static") \
+                or request.path.startswith("/v1/ui/") \
                 or request.method == "OPTIONS":
             return await handler(request)
         auth = request.headers.get("Authorization", "")

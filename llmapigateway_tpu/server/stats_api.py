@@ -37,7 +37,7 @@ async def get_usage_stats(request: web.Request) -> web.Response:
 async def get_usage_records(request: web.Request) -> web.Response:
     gw = request.app["gateway"]
     try:
-        limit = min(200, int(request.query.get("limit", "25")))
+        limit = max(1, min(200, int(request.query.get("limit", "25"))))
         offset = max(0, int(request.query.get("offset", "0")))
     except ValueError:
         return web.json_response({"detail": "limit/offset must be ints"}, status=400)

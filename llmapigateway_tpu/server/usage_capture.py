@@ -111,12 +111,24 @@ class UsageCollector:
             return
         self._ended = True
         self._t_end = time.monotonic()
+        # SQLite fsync + transcript write/prune are blocking I/O; offload so a
+        # stream's finally-block never stalls the event loop.
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            loop = None
+        if loop is not None:
+            loop.run_in_executor(None, self._record_safe, error)
+        else:
+            self._record_safe(error)
+
+    # -- recording ------------------------------------------------------------
+    def _record_safe(self, error: str | None) -> None:
         try:
             self._record(error)
         except Exception:
             logger.exception("usage record failed (ignored)")
 
-    # -- recording ------------------------------------------------------------
     @property
     def ttft_ms(self) -> float | None:
         if self._t_first is None:

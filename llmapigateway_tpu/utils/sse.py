@@ -16,12 +16,15 @@ SSE_DONE = "[DONE]"
 
 
 def format_sse(data: Any) -> bytes:
-    """Format one SSE data frame. `data` may be a dict (JSON-encoded) or str."""
+    """Format one SSE data frame. `data` may be a dict (JSON-encoded) or str.
+    Embedded newlines become multiple ``data:`` lines per the SSE spec (a bare
+    continuation line would be silently dropped by conforming clients)."""
     if isinstance(data, (dict, list)):
         payload = json.dumps(data, ensure_ascii=False, separators=(",", ":"))
     else:
         payload = str(data)
-    return f"data: {payload}\n\n".encode()
+    body = "".join(f"data: {line}\n" for line in payload.split("\n"))
+    return (body + "\n").encode()
 
 
 @dataclass
