@@ -1,0 +1,120 @@
+"""Benchmark: local-engine decode throughput on the real chip.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N}
+
+Measures steady-state decode tokens/sec through the serving engine
+(continuous batch full, per-slot sampling, cache attention) for a
+TinyLlama-1.1B-architecture model (random weights — zero-egress image, no
+checkpoint downloads; decode FLOPs/bandwidth are weight-value-independent).
+``vs_baseline`` is value / 2000 — the BASELINE.md north-star decode
+tok/s/chip target.
+
+Usage: python bench.py [--preset tinyllama-1.1b] [--batch 8] [--steps 200]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="tinyllama-1.1b")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--warmup", type=int, default=20)
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--prompt-len", type=int, default=128)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from llmapigateway_tpu.config.schemas import LocalEngineConfig
+    from llmapigateway_tpu.engine.engine import InferenceEngine
+    from llmapigateway_tpu.engine.sampling import SamplingParams
+
+    eng_cfg = LocalEngineConfig(
+        preset=args.preset, dtype="bfloat16", max_batch_size=args.batch,
+        max_seq_len=args.seq, prefill_chunk=min(512, args.prompt_len))
+    t0 = time.monotonic()
+    engine = InferenceEngine(eng_cfg)
+    init_s = time.monotonic() - t0
+
+    B, S = engine.B, engine.S
+    rng = np.random.default_rng(0)
+
+    # Fill every slot's cache with a prompt (prefill), then time decode.
+    t0 = time.monotonic()
+    prompt = rng.integers(0, engine.model_cfg.vocab_size,
+                          size=args.prompt_len).astype(np.int32)
+    for slot in range(B):
+        pos = 0
+        while pos < len(prompt):
+            chunk = prompt[pos:pos + engine.prefill_chunk]
+            padded = np.zeros((1, engine.prefill_chunk), np.int32)
+            padded[0, :len(chunk)] = chunk
+            logits, engine.cache = engine._prefill_fn(
+                engine.params, engine.cache, jnp.asarray(padded),
+                jnp.int32(pos), jnp.int32(slot))
+            pos += len(chunk)
+        engine.lengths[slot] = len(prompt)
+        engine.active[slot] = True
+        engine.last_token[slot] = 1
+        np.asarray(logits[:1, :1])       # real sync (see NOTE below)
+    prefill_s = time.monotonic() - t0
+    prefill_tok_s = B * args.prompt_len / prefill_s
+
+    samp = SamplingParams(
+        temperature=jnp.asarray(engine.samp_temperature),
+        top_p=jnp.asarray(engine.samp_top_p),
+        top_k=jnp.asarray(engine.samp_top_k))
+    lengths = jnp.asarray(engine.lengths)
+    active = jnp.asarray(engine.active)
+    tokens = jnp.asarray(engine.last_token)
+    key = jax.random.PRNGKey(0)
+
+    def step(tokens, lengths, key):
+        key, sub = jax.random.split(key)
+        next_tokens, engine.cache = engine._decode_fn(
+            engine.params, engine.cache, tokens, lengths, active, samp, sub)
+        return next_tokens, lengths + 1, key
+
+    # NOTE: block_until_ready does not reliably sync through the axon TPU
+    # tunnel; fetching the sampled token values (np.asarray) is the honest
+    # sync — and matches the serving loop, which reads every step's tokens.
+    for _ in range(args.warmup):
+        tokens, lengths, key = step(tokens, lengths, key)
+    np.asarray(tokens)
+
+    t0 = time.monotonic()
+    for _ in range(args.steps):
+        tokens, lengths, key = step(tokens, lengths, key)
+        np.asarray(tokens)
+    decode_s = time.monotonic() - t0
+
+    tok_s = B * args.steps / decode_s
+    ms_per_step = 1000.0 * decode_s / args.steps
+
+    result = {
+        "metric": f"decode_tok_s_chip ({args.preset}, bs={B}, "
+                  f"ctx={args.prompt_len}+{args.steps})",
+        "value": round(tok_s, 1),
+        "unit": "tok/s",
+        "vs_baseline": round(tok_s / 2000.0, 3),
+        "extra": {
+            "ms_per_decode_step": round(ms_per_step, 3),
+            "prefill_tok_s": round(prefill_tok_s, 1),
+            "engine_init_s": round(init_s, 1),
+            "device": str(jax.devices()[0]),
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

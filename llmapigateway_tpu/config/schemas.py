@@ -39,6 +39,7 @@ class LocalEngineConfig(BaseModel):
     kv_page_size: int = 128
     kv_num_pages: int = 0           # 0 → derived from max_batch_size*max_seq_len
     prefill_chunk: int = 512
+    decode_burst: int = 8           # chained decode steps per host sync
     max_tokens_default: int = 1024
     attention: str = "auto"         # "auto" | "pallas" | "reference"
     tokenizer_path: str | None = None

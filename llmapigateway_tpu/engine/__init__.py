@@ -1,0 +1,3 @@
+"""In-process JAX/XLA serving engine — the capability the reference lacks
+entirely (SURVEY.md §2b): model execution on TPU behind the same provider
+contract as remote HTTP vendors."""

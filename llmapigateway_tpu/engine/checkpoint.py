@@ -1,0 +1,201 @@
+"""HF safetensors checkpoints → stacked-layer JAX params, sharded on load.
+
+The reference has no model checkpoints at all (SURVEY.md §5 "Checkpoint /
+resume"); this implements the TPU-side story: stream tensors from
+safetensors shards and place each directly into its GSPMD sharding layout
+(per-device ``jax.device_put``), so a 70B model never materializes unsharded
+on one host.
+
+Supports the HF Llama/Mistral naming scheme (TinyLlama, Llama-2/3) and
+Mixtral's MoE naming. Torch ``nn.Linear`` stores ``[out, in]``; JAX matmul
+layout here is ``[in, out]`` — every projection is transposed on load.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import re
+from pathlib import Path
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from safetensors import safe_open
+
+from ..models.config import ModelConfig
+
+logger = logging.getLogger(__name__)
+
+
+def _discover_shards(model_dir: Path) -> list[Path]:
+    index = model_dir / "model.safetensors.index.json"
+    if index.exists():
+        data = json.loads(index.read_text())
+        files = sorted(set(data["weight_map"].values()))
+        return [model_dir / f for f in files]
+    single = model_dir / "model.safetensors"
+    if single.exists():
+        return [single]
+    shards = sorted(model_dir.glob("*.safetensors"))
+    if not shards:
+        raise FileNotFoundError(f"no safetensors files in {model_dir}")
+    return shards
+
+
+# HF tensor name → (our path, needs_transpose). {i} = layer, {e} = expert.
+_LLAMA_MAP: list[tuple[re.Pattern, str, bool]] = [
+    (re.compile(r"^model\.embed_tokens\.weight$"), "embed", False),
+    (re.compile(r"^model\.norm\.weight$"), "final_norm", False),
+    (re.compile(r"^lm_head\.weight$"), "lm_head", False),
+    (re.compile(r"^model\.layers\.(\d+)\.input_layernorm\.weight$"),
+     "layers.attn_norm.{i}", False),
+    (re.compile(r"^model\.layers\.(\d+)\.self_attn\.q_proj\.weight$"),
+     "layers.wq.{i}", True),
+    (re.compile(r"^model\.layers\.(\d+)\.self_attn\.k_proj\.weight$"),
+     "layers.wk.{i}", True),
+    (re.compile(r"^model\.layers\.(\d+)\.self_attn\.v_proj\.weight$"),
+     "layers.wv.{i}", True),
+    (re.compile(r"^model\.layers\.(\d+)\.self_attn\.o_proj\.weight$"),
+     "layers.wo.{i}", True),
+    (re.compile(r"^model\.layers\.(\d+)\.post_attention_layernorm\.weight$"),
+     "layers.mlp_norm.{i}", False),
+    (re.compile(r"^model\.layers\.(\d+)\.mlp\.gate_proj\.weight$"),
+     "layers.wg.{i}", True),
+    (re.compile(r"^model\.layers\.(\d+)\.mlp\.up_proj\.weight$"),
+     "layers.wu.{i}", True),
+    (re.compile(r"^model\.layers\.(\d+)\.mlp\.down_proj\.weight$"),
+     "layers.wd.{i}", True),
+    # Mixtral MoE
+    (re.compile(r"^model\.layers\.(\d+)\.block_sparse_moe\.gate\.weight$"),
+     "layers.router.{i}", True),
+    (re.compile(r"^model\.layers\.(\d+)\.block_sparse_moe\.experts\.(\d+)\.w1\.weight$"),
+     "layers.wg.{i}.{e}", True),
+    (re.compile(r"^model\.layers\.(\d+)\.block_sparse_moe\.experts\.(\d+)\.w3\.weight$"),
+     "layers.wu.{i}.{e}", True),
+    (re.compile(r"^model\.layers\.(\d+)\.block_sparse_moe\.experts\.(\d+)\.w2\.weight$"),
+     "layers.wd.{i}.{e}", True),
+]
+
+
+def _map_name(hf_name: str) -> tuple[str, int | None, int | None, bool] | None:
+    """→ (bare param key, layer index, expert index, transpose) or None.
+    The key is the leaf name inside the params tree ('wq', 'attn_norm', ...
+    or 'embed'/'final_norm'/'lm_head' for layerless tensors)."""
+    for pattern, target, transpose in _LLAMA_MAP:
+        m = pattern.match(hf_name)
+        if m:
+            groups = m.groups()
+            layer = int(groups[0]) if groups else None
+            expert = int(groups[1]) if len(groups) > 1 else None
+            key = target.split(".{i}")[0]
+            if key.startswith("layers."):
+                key = key[len("layers."):]
+            return key, layer, expert, transpose
+    return None
+
+
+def load_checkpoint(model_dir: str | Path, config: ModelConfig,
+                    dtype: jnp.dtype = jnp.bfloat16,
+                    put: Callable[[str, np.ndarray], jax.Array] | None = None
+                    ) -> dict[str, Any]:
+    """Load an HF checkpoint into the stacked-layer params layout.
+
+    ``put(param_path, np_array) -> jax.Array`` controls placement — the
+    engine passes a sharded ``device_put``; default is plain host transfer.
+    Stacking happens per-parameter: each layer's tensor is placed as soon as
+    all layers for that name are read, bounding host memory.
+    """
+    model_dir = Path(model_dir)
+    shards = _discover_shards(model_dir)
+    put = put or (lambda path, arr: jnp.asarray(arr))
+
+    # Pass 1: index — which shard holds each mapped tensor (metadata only).
+    index: dict[str, tuple[Path, str, bool, int | None, int | None]] = {}
+    grouped: dict[str, list[str]] = {}     # param key -> [hf names]
+    for shard in shards:
+        with safe_open(str(shard), framework="numpy") as f:
+            for name in f.keys():
+                mapped = _map_name(name)
+                if mapped is None:
+                    logger.debug("skipping unmapped tensor %s", name)
+                    continue
+                key, layer, expert, transpose = mapped
+                index[name] = (shard, key, transpose, layer, expert)
+                grouped.setdefault(key, []).append(name)
+
+    # Pass 2: one parameter group at a time — read its tensors (layer by
+    # layer), stack, place sharded, free. Host memory is bounded by the
+    # largest single stacked parameter, not the whole checkpoint.
+    open_shards: dict[Path, Any] = {}
+
+    def read(name: str) -> np.ndarray:
+        shard, _, transpose, _, _ = index[name]
+        if shard not in open_shards:
+            open_shards[shard] = safe_open(str(shard), framework="numpy")
+        arr = np.asarray(open_shards[shard].get_tensor(name))
+        if transpose:
+            arr = arr.T
+        return arr.astype(_np_dtype(dtype))
+
+    params: dict[str, Any] = {"layers": {}}
+    try:
+        for key, names in grouped.items():
+            entries = [(index[n][3], index[n][4], n) for n in names]
+            if entries[0][0] is None:                       # layerless tensor
+                params[key] = put(key, read(names[0]))
+                continue
+            has_experts = any(e is not None for (_, e, _) in entries)
+            by_pos = {(l, e): n for l, e, n in entries}
+            n_layers = max(l for l, _, _ in entries) + 1
+            if has_experts:
+                n_experts = max(e for _, e, _ in entries) + 1
+                stacked = np.stack([
+                    np.stack([read(by_pos[(l, e)]) for e in range(n_experts)])
+                    for l in range(n_layers)])
+            else:
+                stacked = np.stack([read(by_pos[(l, None)])
+                                    for l in range(n_layers)])
+            params["layers"][key] = put(f"layers.{key}", stacked)
+            del stacked
+    finally:
+        open_shards.clear()
+
+    if "lm_head" not in params:
+        if not config.tie_embeddings:
+            logger.info("no lm_head in checkpoint; using tied embeddings")
+        params["lm_head"] = params["embed"]
+    _validate_shapes(params, config)
+    return params
+
+
+def _np_dtype(dtype: jnp.dtype):
+    # numpy has no bfloat16; use ml_dtypes (bundled with jax).
+    if dtype == jnp.bfloat16:
+        import ml_dtypes
+        return ml_dtypes.bfloat16
+    return np.dtype(dtype)
+
+
+def _validate_shapes(params: dict[str, Any], config: ModelConfig) -> None:
+    c = config
+    checks = {
+        "embed": (c.vocab_size, c.d_model),
+        "final_norm": (c.d_model,),
+    }
+    for key, want in checks.items():
+        got = tuple(params[key].shape)
+        if got != want:
+            raise ValueError(f"checkpoint/config mismatch: {key} is {got}, "
+                             f"config implies {want}")
+    lk = params["layers"]
+    required = {"attn_norm", "wq", "wk", "wv", "wo", "mlp_norm"}
+    required |= {"router"} if c.is_moe else {"wg", "wu", "wd"}
+    missing = required - set(lk)
+    if missing:
+        raise ValueError(f"checkpoint is missing layer params {sorted(missing)}; "
+                         f"loaded keys: {sorted(lk)}")
+    want = (c.n_layers, c.d_model, c.n_heads * c.head_dim)
+    if tuple(lk["wq"].shape) != want:
+        raise ValueError(f"checkpoint/config mismatch: layers.wq is "
+                         f"{tuple(lk['wq'].shape)}, config implies {want}")

@@ -1,0 +1,522 @@
+"""The serving engine: slot-based continuous batching over two compiled XLA
+programs (chunked prefill + batched decode step).
+
+Design (SURVEY.md §2b "Serving scheduler", §7 steps 5-6):
+
+* **Fixed shapes everywhere.** The decode program is compiled once for the
+  full slot batch ``[B]``; inactive slots ride along masked (`active`), so
+  admission/retirement never recompiles. Prefill is compiled per power-of-2
+  chunk bucket, padded — pad tokens land beyond the true length and are
+  masked off by the length-based causal mask, then overwritten by the next
+  chunk.
+* **Continuous batching.** New requests are admitted into free slots between
+  decode steps; prefill runs chunk-at-a-time so a long prompt never blocks
+  decode for more than one chunk (chunked-prefill interleave).
+* **The engine is an async service.** Compiled-program calls are offloaded
+  to a worker thread (`asyncio.to_thread`) so the gateway's event loop keeps
+  serving; results stream back through per-sequence asyncio queues.
+* Per-slot sampling params live in device arrays; sampling is part of the
+  decode program (no host round-trip per token beyond the sampled ids).
+
+The KV cache here is the dense per-slot layout (models/llama.py `KVCache`);
+ops/paged_attention.py supplies the paged-attention upgrade path.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, AsyncIterator
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config.schemas import LocalEngineConfig
+from ..models import llama
+from ..models.config import ModelConfig, get_preset
+from ..parallel.mesh import MeshSpec, build_mesh
+from ..parallel.sharding import (
+    batch_sharding, cache_sharding, param_shardings, replicated)
+from .sampling import SamplingParams, sample
+from .tokenizer import IncrementalDetokenizer, load_tokenizer
+
+logger = logging.getLogger(__name__)
+
+
+class EngineOverloaded(Exception):
+    """Admission failed (queue full) — maps to a provider error so the
+    gateway falls back to the next provider in the chain."""
+
+
+@dataclass
+class GenRequest:
+    """One sequence's lifecycle inside the engine."""
+    prompt_ids: list[int]
+    max_tokens: int
+    temperature: float = 0.0
+    top_p: float = 1.0
+    top_k: int = 0
+    stop: list[str] = field(default_factory=list)
+
+    # Filled by the engine:
+    slot: int = -1
+    prefill_pos: int = 0
+    generated: list[int] = field(default_factory=list)
+    out_queue: asyncio.Queue = field(default_factory=asyncio.Queue)
+    detok: IncrementalDetokenizer | None = None
+    text: str = ""
+    emitted_upto: int = 0          # index into `text` already sent downstream
+    cancelled: bool = False        # client gone — stop generating, free slot
+    finish_reason: str | None = None
+    t_submit: float = field(default_factory=time.monotonic)
+    t_first_token: float | None = None
+    t_done: float | None = None
+
+    @property
+    def done(self) -> bool:
+        return self.finish_reason is not None
+
+
+@dataclass
+class Delta:
+    """One streamed event: text delta and/or terminal state."""
+    text: str = ""
+    finish_reason: str | None = None
+    error: str | None = None
+
+
+class InferenceEngine:
+    """Owns params, cache, compiled programs, and the batching loop."""
+
+    def __init__(self, engine_cfg: LocalEngineConfig,
+                 model_cfg: ModelConfig | None = None,
+                 devices: list | None = None):
+        self.cfg = engine_cfg
+        if model_cfg is None:
+            if engine_cfg.preset:
+                model_cfg = get_preset(engine_cfg.preset)
+            elif engine_cfg.model_path:
+                model_cfg = _config_from_checkpoint(engine_cfg.model_path)
+            else:
+                raise ValueError("local engine needs 'preset' or 'model_path'")
+        self.model_cfg = model_cfg
+        self.dtype = jnp.bfloat16 if engine_cfg.dtype == "bfloat16" else \
+            jnp.dtype(engine_cfg.dtype)
+
+        self.mesh = build_mesh(MeshSpec(sizes=dict(engine_cfg.mesh)), devices)
+        self.B = engine_cfg.max_batch_size
+        self.S = min(engine_cfg.max_seq_len, model_cfg.max_seq_len)
+        self.prefill_chunk = engine_cfg.prefill_chunk
+        self.decode_burst = max(1, engine_cfg.decode_burst)
+
+        self.tokenizer = load_tokenizer(
+            engine_cfg.tokenizer_path or engine_cfg.model_path or None,
+            vocab_size=model_cfg.vocab_size)
+
+        self._init_params()
+        self._init_state()
+        self._compile()
+
+        self._queue: asyncio.Queue[GenRequest] = asyncio.Queue(
+            maxsize=max(2 * self.B, 16))
+        self._free_slots = list(range(self.B))
+        self._running: dict[int, GenRequest] = {}
+        self._prefilling: dict[int, GenRequest] = {}
+        self._loop_task: asyncio.Task | None = None
+        self._stopped = False
+        self._work_event = asyncio.Event()
+
+    # -- initialization ------------------------------------------------------
+    def _init_params(self) -> None:
+        c = self.model_cfg
+        t0 = time.monotonic()
+        if self.cfg.model_path:
+            from .checkpoint import load_checkpoint
+            from ..parallel.sharding import spec_for_param
+
+            def put(path: str, arr: np.ndarray) -> jax.Array:
+                return jax.device_put(
+                    arr, spec_for_param(path, tuple(arr.shape), self.mesh))
+            self.params = load_checkpoint(self.cfg.model_path, c,
+                                          dtype=self.dtype, put=put)
+        else:
+            key = jax.random.PRNGKey(0)
+            host_params = llama.init_params(c, key, dtype=self.dtype)
+            shardings = param_shardings(host_params, self.mesh)
+            self.params = jax.tree.map(jax.device_put, host_params, shardings)
+        n_params = sum(int(np.prod(p.shape))
+                       for p in jax.tree.leaves(self.params))
+        logger.info("params ready: %.2fB parameters in %.1fs",
+                    n_params / 1e9, time.monotonic() - t0)
+
+    def _init_state(self) -> None:
+        c = self.model_cfg
+        csh = cache_sharding(self.mesh, c.n_kv_heads, self.B)
+        shape = (c.n_layers, self.B, self.S, c.n_kv_heads, c.head_dim)
+        self.cache = llama.KVCache(
+            k=jax.device_put(jnp.zeros(shape, self.dtype), csh),
+            v=jax.device_put(jnp.zeros(shape, self.dtype), csh))
+        # Host-authoritative per-slot state, mirrored to device each step.
+        self.lengths = np.zeros((self.B,), np.int32)
+        self.active = np.zeros((self.B,), bool)
+        self.last_token = np.zeros((self.B,), np.int32)
+        self.samp_temperature = np.zeros((self.B,), np.float32)
+        self.samp_top_p = np.ones((self.B,), np.float32)
+        self.samp_top_k = np.zeros((self.B,), np.int32)
+        self._rng = jax.random.PRNGKey(int(time.time() * 1e3) % (2**31))
+        # Device-resident mirrors for the chained decode loop; re-uploaded
+        # (once) whenever host slot state changes.
+        self._d_tokens = None
+        self._d_lengths = None
+        self._d_active = None
+        self._d_samp = None
+        self._d_dirty = True
+
+    def _compile(self) -> None:
+        c = self.model_cfg
+        mesh = self.mesh
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def prefill_step(params, cache: llama.KVCache, tokens: jax.Array,
+                         start_len: jax.Array, slot: jax.Array
+                         ) -> tuple[jax.Array, llama.KVCache]:
+            """Run one prompt chunk for one slot. tokens [1, C]."""
+            # Slice this slot's cache rows: [L, 1, S, KV, Dh].
+            k_row = jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1)
+            v_row = jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1)
+            row_cache = llama.KVCache(k=k_row, v=v_row)
+            lengths = start_len[None]
+            logits, row_cache = llama.forward(
+                params, c, tokens, lengths, row_cache)
+            new_k = jax.lax.dynamic_update_slice_in_dim(
+                cache.k, row_cache.k, slot, axis=1)
+            new_v = jax.lax.dynamic_update_slice_in_dim(
+                cache.v, row_cache.v, slot, axis=1)
+            return logits[0], llama.KVCache(k=new_k, v=new_v)
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def decode_step(params, cache: llama.KVCache, tokens: jax.Array,
+                        lengths: jax.Array, active: jax.Array,
+                        samp: SamplingParams, key: jax.Array
+                        ) -> tuple[jax.Array, jax.Array, llama.KVCache]:
+            """One decode step. Returns (next_tokens, new_lengths, cache) so
+            the token/length feedback loop stays ON DEVICE across steps —
+            host fetches happen asynchronously, steps behind (the tunnel's
+            per-fetch latency is ~40 ms; chained dispatch amortizes it)."""
+            logits, cache = llama.forward(
+                params, c, tokens[:, None], lengths, cache, active=active)
+            next_tokens = sample(logits[:, 0, :], samp, key)
+            new_lengths = jnp.where(active, lengths + 1, lengths)
+            return next_tokens, new_lengths, cache
+
+        @jax.jit
+        def sample_one(logits: jax.Array, temperature: jax.Array,
+                       top_p: jax.Array, top_k: jax.Array,
+                       key: jax.Array) -> jax.Array:
+            samp = SamplingParams(temperature=temperature[None],
+                                  top_p=top_p[None], top_k=top_k[None])
+            return sample(logits[None], samp, key)[0]
+
+        self._prefill_fn = prefill_step
+        self._decode_fn = decode_step
+        self._sample_one = sample_one
+
+    # -- public API ----------------------------------------------------------
+    async def start(self) -> None:
+        if self._loop_task is None:
+            self._stopped = False        # restartable after stop()
+            self._loop_task = asyncio.get_running_loop().create_task(
+                self._run_loop())
+
+    async def stop(self) -> None:
+        self._stopped = True
+        self._work_event.set()
+        if self._loop_task is not None:
+            await self._loop_task
+            self._loop_task = None
+
+    async def submit(self, req: GenRequest) -> None:
+        """Admit a request; raises EngineOverloaded when the queue is full."""
+        max_prompt = self.S - 1
+        if len(req.prompt_ids) > max_prompt:
+            raise EngineOverloaded(
+                f"prompt of {len(req.prompt_ids)} tokens exceeds engine "
+                f"max_seq_len {self.S}")
+        req.max_tokens = max(1, min(req.max_tokens,
+                                    self.S - len(req.prompt_ids)))
+        req.detok = IncrementalDetokenizer(self.tokenizer)
+        try:
+            self._queue.put_nowait(req)
+        except asyncio.QueueFull:
+            raise EngineOverloaded("engine admission queue is full") from None
+        await self.start()
+        self._work_event.set()
+
+    async def stream(self, req: GenRequest) -> AsyncIterator[Delta]:
+        """Yield deltas for a submitted request until it finishes."""
+        while True:
+            delta: Delta = await req.out_queue.get()
+            yield delta
+            if delta.finish_reason is not None or delta.error is not None:
+                return
+
+    # -- the batching loop ---------------------------------------------------
+    async def _run_loop(self) -> None:
+        logger.info("engine loop started (B=%d, S=%d)", self.B, self.S)
+        while not self._stopped:
+            try:
+                progressed = await self._step()
+            except Exception as e:           # engine must never die silently
+                logger.exception("engine step failed")
+                for req in list(self._running.values()):
+                    req.out_queue.put_nowait(Delta(error=f"engine failure: {e}"))
+                    self._release(req)
+                progressed = True
+            if not progressed:
+                self._work_event.clear()
+                await self._work_event.wait()
+        logger.info("engine loop stopped")
+
+    async def _step(self) -> bool:
+        """One scheduler iteration. Emission always happens here, on the
+        event-loop thread (asyncio.Queue is not thread-safe); worker-thread
+        calls only touch device programs and host numpy state."""
+        # 1. Admit into free slots (dropping requests whose client is gone).
+        while self._free_slots and not self._queue.empty():
+            req = self._queue.get_nowait()
+            if req.cancelled:
+                req.finish_reason = "cancelled"
+                continue
+            req.slot = self._free_slots.pop()
+            req.prefill_pos = 0
+            self._running[req.slot] = req
+            self._prefilling[req.slot] = req
+
+        # 2. Advance each pending prefill by ONE chunk (chunked-prefill
+        #    interleave: a long prompt never blocks decode for more than one
+        #    chunk — SURVEY.md §7 hard part (6)).
+        for slot, req in list(self._prefilling.items()):
+            if req.cancelled:
+                self._finish(req, "cancelled", emit=False)
+                continue
+            prompt_done = await asyncio.to_thread(self._prefill_one_chunk, req)
+            if prompt_done:
+                del self._prefilling[slot]
+                self._emit_token(req)      # first token, sampled off prefill
+
+        # 3. A decode burst for all slots in decode phase. Burst depth adapts:
+        #    stay shallow when new work is waiting (prefill responsiveness →
+        #    TTFT), go deep when the batch is just decoding (throughput; deep
+        #    bursts hide the device↔host fetch latency).
+        decoding = [r for r in self._running.values()
+                    if not r.done and r.slot not in self._prefilling]
+        if decoding:
+            busy = not self._queue.empty() or bool(self._prefilling)
+            burst = 1 if busy else self.decode_burst
+            # Never burst past any slot's cache capacity or token budget.
+            for r in decoding:
+                burst = min(burst,
+                            self.S - int(self.lengths[r.slot]),
+                            max(1, r.max_tokens - len(r.generated)))
+            burst = max(1, burst)
+            step_tokens = await asyncio.to_thread(self._decode_burst, burst)
+            for tokens in step_tokens:          # in generation order
+                for req in decoding:
+                    if req.done:
+                        continue
+                    req.generated.append(int(tokens[req.slot]))
+                    self._emit_token(req)
+            return True
+        return bool(self._prefilling)
+
+    # -- compute (worker thread; no asyncio objects touched) ------------------
+    def _prefill_one_chunk(self, req: GenRequest) -> bool:
+        """Run one prompt chunk; returns True when the prompt is complete
+        (first token sampled and slot armed for decode)."""
+        slot = req.slot
+        ids = req.prompt_ids
+        pos = req.prefill_pos
+        if pos == 0:
+            self.lengths[slot] = 0
+            self.active[slot] = False
+        chunk = np.asarray(ids[pos:pos + self.prefill_chunk], np.int32)
+        bucket = _bucket(len(chunk), self.prefill_chunk)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[:, :len(chunk)] = chunk
+        logits, self.cache = self._prefill_fn(
+            self.params, self.cache, jnp.asarray(padded),
+            jnp.int32(pos), jnp.int32(slot))
+        req.prefill_pos = pos + len(chunk)
+        if req.prefill_pos < len(ids):
+            return False
+
+        # Prompt complete: sample the first token from the last real position.
+        self._rng, key = jax.random.split(self._rng)
+        first = self._sample_one(
+            logits[len(chunk) - 1], jnp.float32(req.temperature),
+            jnp.float32(req.top_p), jnp.int32(req.top_k), key)
+        first_id = int(first)
+        req.generated.append(first_id)
+        req.t_first_token = time.monotonic()
+        self.lengths[slot] = len(ids)
+        self.last_token[slot] = first_id
+        self.active[slot] = True
+        self.samp_temperature[slot] = req.temperature
+        self.samp_top_p[slot] = req.top_p
+        self.samp_top_k[slot] = req.top_k
+        self._d_dirty = True
+        return True
+
+    def _decode_burst(self, n_steps: int) -> list[np.ndarray]:
+        """Run `n_steps` chained decode steps; tokens/lengths feed back as
+        device arrays (no host round-trip inside the chain) and each step's
+        sampled tokens are fetched asynchronously behind the dispatch wave.
+        Returns the per-step host token arrays, in order."""
+        if self._d_dirty:
+            # Host slot state changed (admission/release/prefill): upload once.
+            self._d_tokens = jnp.asarray(self.last_token)
+            self._d_lengths = jnp.asarray(self.lengths)
+            self._d_active = jnp.asarray(self.active)
+            self._d_samp = SamplingParams(
+                temperature=jnp.asarray(self.samp_temperature),
+                top_p=jnp.asarray(self.samp_top_p),
+                top_k=jnp.asarray(self.samp_top_k))
+            self._d_dirty = False
+
+        pending: list[jax.Array] = []
+        for _ in range(n_steps):
+            self._rng, key = jax.random.split(self._rng)
+            self._d_tokens, self._d_lengths, self.cache = self._decode_fn(
+                self.params, self.cache, self._d_tokens, self._d_lengths,
+                self._d_active, self._d_samp, key)
+            try:
+                self._d_tokens.copy_to_host_async()
+            except Exception:       # backend without async copies
+                pass
+            pending.append(self._d_tokens)
+
+        step_tokens = [np.asarray(t) for t in pending]
+        # Mirror device-side length advance on the host.
+        self.lengths[self.active] += n_steps
+        for slot in np.nonzero(self.active)[0]:
+            self.last_token[slot] = int(step_tokens[-1][slot])
+        return step_tokens
+
+    # -- emission / lifecycle (event-loop thread only) ------------------------
+    def _emit_token(self, req: GenRequest) -> None:
+        if req.cancelled:
+            self._finish(req, "cancelled", emit=False)
+            return
+        tok = req.generated[-1]
+        if tok in self.tokenizer.eos_ids:
+            self._finish(req, "stop")
+            return
+        req.text += req.detok.push(tok)
+
+        # OpenAI `stop` semantics: the stop sequence (and anything after it)
+        # is excluded from the output. Because stops can span token/delta
+        # boundaries, text that could still be a stop prefix is HELD BACK
+        # until resolved — a complete match therefore always starts at or
+        # after `emitted_upto`.
+        if req.stop:
+            idx = -1
+            for s in req.stop:
+                found = req.text.find(s, max(0, req.emitted_upto - 0))
+                if found >= 0 and (idx < 0 or found < idx):
+                    idx = found
+            if idx >= 0:
+                req.text = req.text[:idx]
+                self._finish(req, "stop", flush_detok=False)
+                return
+
+        if len(req.generated) >= req.max_tokens:
+            self._finish(req, "length")
+            return
+        # Exact per-token cache-capacity check (host `lengths` may already be
+        # a whole burst ahead of the token being emitted).
+        if len(req.prompt_ids) + len(req.generated) + 1 >= self.S:
+            self._finish(req, "length")
+            return
+
+        # Emit everything except the longest tail that is a proper prefix of
+        # some stop string (held back until it resolves either way).
+        hold = 0
+        unemitted = len(req.text) - req.emitted_upto
+        for s in req.stop:
+            for k in range(min(len(s) - 1, unemitted), hold, -1):
+                if req.text.endswith(s[:k]):
+                    hold = k
+                    break
+        safe_upto = len(req.text) - hold
+        if safe_upto > req.emitted_upto:
+            delta = req.text[req.emitted_upto:safe_upto]
+            req.emitted_upto = safe_upto
+            req.out_queue.put_nowait(Delta(text=delta))
+
+    def _finish(self, req: GenRequest, reason: str, emit: bool = True,
+                flush_detok: bool = True) -> None:
+        if flush_detok and reason != "cancelled":
+            req.text += req.detok.flush()
+        req.finish_reason = reason
+        req.t_done = time.monotonic()
+        if emit:
+            delta = req.text[req.emitted_upto:]
+            req.emitted_upto = len(req.text)
+            req.out_queue.put_nowait(Delta(text=delta, finish_reason=reason))
+        self._release(req)
+
+    def _release(self, req: GenRequest) -> None:
+        if req.slot in self._running:
+            del self._running[req.slot]
+            self._prefilling.pop(req.slot, None)
+            self.active[req.slot] = False
+            self.lengths[req.slot] = 0
+            self._free_slots.append(req.slot)
+            self._d_dirty = True
+
+    # -- stats ----------------------------------------------------------------
+    def stats(self) -> dict[str, Any]:
+        return {
+            "running": len(self._running),
+            "queued": self._queue.qsize(),
+            "free_slots": len(self._free_slots),
+            "batch_size": self.B,
+            "max_seq_len": self.S,
+        }
+
+
+def _bucket(n: int, cap: int) -> int:
+    """Next power of two ≥ n, capped (prefill compile buckets)."""
+    b = 8
+    while b < n:
+        b *= 2
+    return min(b, cap)
+
+
+def _config_from_checkpoint(model_path: str) -> ModelConfig:
+    """Derive ModelConfig from an HF checkpoint's config.json."""
+    import json
+    from pathlib import Path
+    cfg = json.loads((Path(model_path) / "config.json").read_text())
+    mtype = cfg.get("model_type", "llama")
+    common = dict(
+        vocab_size=cfg["vocab_size"],
+        d_model=cfg["hidden_size"],
+        n_layers=cfg["num_hidden_layers"],
+        n_heads=cfg["num_attention_heads"],
+        n_kv_heads=cfg.get("num_key_value_heads", cfg["num_attention_heads"]),
+        d_ff=cfg["intermediate_size"],
+        rope_theta=cfg.get("rope_theta", 10000.0),
+        rms_eps=cfg.get("rms_norm_eps", 1e-5),
+        max_seq_len=cfg.get("max_position_embeddings", 4096),
+        tie_embeddings=cfg.get("tie_word_embeddings", False),
+    )
+    if mtype == "mixtral":
+        return ModelConfig(family="mixtral",
+                           n_experts=cfg.get("num_local_experts", 8),
+                           experts_per_token=cfg.get("num_experts_per_tok", 2),
+                           **common)
+    return ModelConfig(family="llama", **common)

@@ -1,0 +1,3 @@
+from .config import ModelConfig, PRESETS, get_preset
+
+__all__ = ["ModelConfig", "PRESETS", "get_preset"]

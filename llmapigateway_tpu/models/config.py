@@ -1,0 +1,70 @@
+"""Model architecture configs and named presets.
+
+Presets cover the BASELINE.md ladder: tiny-test (CI), TinyLlama-1.1B
+(config 1), Llama-3-8B (configs 2-3), Mixtral-8x7B (config 4, MoE),
+Llama-3-70B (config 5, multi-host).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    family: str = "llama"          # "llama" | "mixtral"
+    vocab_size: int = 32000
+    d_model: int = 2048
+    n_layers: int = 22
+    n_heads: int = 32
+    n_kv_heads: int = 4
+    d_ff: int = 5632
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    max_seq_len: int = 4096
+    tie_embeddings: bool = False
+    # MoE (mixtral) fields
+    n_experts: int = 0             # 0 → dense
+    experts_per_token: int = 2
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def is_moe(self) -> bool:
+        return self.n_experts > 0
+
+
+PRESETS: dict[str, ModelConfig] = {
+    # Tiny model for tests: fast to init/compile on CPU devices.
+    "tiny-test": ModelConfig(
+        vocab_size=512, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=256),
+    "tiny-moe-test": ModelConfig(
+        family="mixtral", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+        n_kv_heads=2, d_ff=128, max_seq_len=256, n_experts=4,
+        experts_per_token=2),
+    # TinyLlama-1.1B (HF: TinyLlama/TinyLlama-1.1B-Chat-v1.0).
+    "tinyllama-1.1b": ModelConfig(
+        vocab_size=32000, d_model=2048, n_layers=22, n_heads=32, n_kv_heads=4,
+        d_ff=5632, rope_theta=10000.0, max_seq_len=2048),
+    # Llama-3-8B (HF: meta-llama/Meta-Llama-3-8B-Instruct).
+    "llama-3-8b": ModelConfig(
+        vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, d_ff=14336, rope_theta=500000.0, max_seq_len=8192),
+    # Llama-3-70B.
+    "llama-3-70b": ModelConfig(
+        vocab_size=128256, d_model=8192, n_layers=80, n_heads=64,
+        n_kv_heads=8, d_ff=28672, rope_theta=500000.0, max_seq_len=8192),
+    # Mixtral-8x7B (HF: mistralai/Mixtral-8x7B-Instruct-v0.1).
+    "mixtral-8x7b": ModelConfig(
+        family="mixtral", vocab_size=32000, d_model=4096, n_layers=32,
+        n_heads=32, n_kv_heads=8, d_ff=14336, rope_theta=1000000.0,
+        max_seq_len=32768, n_experts=8, experts_per_token=2),
+}
+
+
+def get_preset(name: str) -> ModelConfig:
+    if name not in PRESETS:
+        raise KeyError(f"unknown model preset {name!r}; known: {sorted(PRESETS)}")
+    return PRESETS[name]

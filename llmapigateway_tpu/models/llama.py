@@ -1,0 +1,251 @@
+"""Llama-family decoder as pure JAX functions.
+
+TPU-first design decisions (not a port of any torch module structure):
+
+* **Stacked layer parameters + ``lax.scan`` over layers** — one compiled
+  layer body regardless of depth, keeping compile time flat for 80-layer
+  models and letting GSPMD treat every layer identically.
+* **One forward for prefill and decode** — tokens ``[B, T]`` with ``T`` the
+  prefill chunk (or 1 for decode) against a fixed-shape KV cache, so XLA
+  compiles exactly two programs (per bucket) and shapes never depend on data.
+* **Pluggable attention** — the cache-attention inner op is an argument, so
+  the reference jnp implementation and the Pallas paged kernel interchange
+  without touching model code.
+* bfloat16 params/activations by default (MXU-native), fp32 for RMSNorm
+  accumulation, rotary tables, and logits.
+
+Covers Llama 1/2/3 and TinyLlama (GQA via ``n_kv_heads``), and provides the
+attention/norm blocks Mixtral reuses (models/mixtral.py).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .config import ModelConfig
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Initialization
+# ---------------------------------------------------------------------------
+
+def init_params(config: ModelConfig, key: jax.Array,
+                dtype: jnp.dtype = jnp.bfloat16) -> Params:
+    """Random-init params in the stacked-layer layout.
+
+    Layout (leaf shapes; L = n_layers, D = d_model, H/KV = heads, Dh = head
+    dim, F = d_ff, V = vocab):
+      embed [V, D]; final_norm [D]; lm_head [V, D] (absent if tied)
+      layers/{attn_norm [L,D], wq [L,D,H*Dh], wk [L,D,KV*Dh], wv [L,D,KV*Dh],
+              wo [L,H*Dh,D], mlp_norm [L,D], wg [L,D,F], wu [L,D,F], wd [L,F,D]}
+    """
+    c = config
+    keys = jax.random.split(key, 10)
+    dh = c.head_dim
+
+    def norm_init(*shape):
+        return jnp.ones(shape, dtype=dtype)
+
+    def dense_init(k, *shape):
+        fan_in = shape[-2] if len(shape) >= 2 else shape[-1]
+        scale = 1.0 / jnp.sqrt(fan_in)
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * scale).astype(dtype)
+
+    params: Params = {
+        "embed": dense_init(keys[0], c.vocab_size, c.d_model),
+        "final_norm": norm_init(c.d_model),
+        "layers": {
+            "attn_norm": norm_init(c.n_layers, c.d_model),
+            "wq": dense_init(keys[1], c.n_layers, c.d_model, c.n_heads * dh),
+            "wk": dense_init(keys[2], c.n_layers, c.d_model, c.n_kv_heads * dh),
+            "wv": dense_init(keys[3], c.n_layers, c.d_model, c.n_kv_heads * dh),
+            "wo": dense_init(keys[4], c.n_layers, c.n_heads * dh, c.d_model),
+            "mlp_norm": norm_init(c.n_layers, c.d_model),
+            "wg": dense_init(keys[5], c.n_layers, c.d_model, c.d_ff),
+            "wu": dense_init(keys[6], c.n_layers, c.d_model, c.d_ff),
+            "wd": dense_init(keys[7], c.n_layers, c.d_ff, c.d_model),
+        },
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = dense_init(keys[8], c.vocab_size, c.d_model)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
+    """RMSNorm with fp32 accumulation (bf16 variance underflows)."""
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(xf * xf, axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    return (normed * weight.astype(jnp.float32)).astype(x.dtype)
+
+
+def rope_tables(positions: jax.Array, head_dim: int,
+                theta: float) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables [..., head_dim/2] (fp32) for given absolute positions."""
+    half = head_dim // 2
+    freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    angles = positions.astype(jnp.float32)[..., None] * freqs   # [..., half]
+    return jnp.cos(angles), jnp.sin(angles)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate pairs (x[..., :half], x[..., half:]) — HF llama convention.
+    x: [B, T, N, Dh]; cos/sin: [B, T, half]."""
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    cos = cos[:, :, None, :]
+    sin = sin[:, :, None, :]
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out1 = xf1 * cos - xf2 * sin
+    out2 = xf2 * cos + xf1 * sin
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
+
+
+class KVCache(NamedTuple):
+    """Dense per-slot KV cache, stacked over layers.
+
+    k, v: [L, B, S_max, KV, Dh]. ``lengths`` ([B], int32) — tokens already
+    cached per slot — lives in the engine's batch state, not here, so the
+    cache stays a plain pytree of arrays.
+    """
+    k: jax.Array
+    v: jax.Array
+
+    @classmethod
+    def create(cls, config: ModelConfig, batch: int, max_seq: int,
+               dtype=jnp.bfloat16) -> "KVCache":
+        shape = (config.n_layers, batch, max_seq, config.n_kv_heads,
+                 config.head_dim)
+        return cls(k=jnp.zeros(shape, dtype=dtype),
+                   v=jnp.zeros(shape, dtype=dtype))
+
+
+def dense_cache_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
+                          layer_k: jax.Array, layer_v: jax.Array,
+                          lengths: jax.Array,
+                          active: jax.Array | None = None
+                          ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Reference cache attention (pure jnp; the Pallas paged kernel replaces
+    this on TPU — ops/paged_attention.py).
+
+    q:      [B, T, H, Dh] (RoPE already applied)
+    k_new:  [B, T, KV, Dh], v_new same — new tokens to insert at `lengths`.
+    layer_k/v: [B, S, KV, Dh] — this layer's cache.
+    lengths: [B] int32 — tokens already cached (insert offset).
+    Returns (attn_out [B, T, H*Dh], updated layer_k, layer_v).
+    """
+    B, T, H, Dh = q.shape
+    KV = k_new.shape[2]
+    S = layer_k.shape[1]
+
+    # Insert new tokens at [lengths, lengths+T) per batch row. T is static;
+    # offsets are data — use dynamic_update_slice per row through vmap (XLA
+    # lowers to efficient dynamic-slice on TPU). Inactive rows (slots mid-
+    # prefill or idle during a decode step) must NOT be written: their cache
+    # is owned by the prefill path.
+    def insert(cache_row, new_row, offset):
+        return jax.lax.dynamic_update_slice(
+            cache_row, new_row.astype(cache_row.dtype), (offset, 0, 0))
+    inserted_k = jax.vmap(insert)(layer_k, k_new, lengths)
+    inserted_v = jax.vmap(insert)(layer_v, v_new, lengths)
+    if active is not None:
+        keep = active[:, None, None, None]
+        layer_k = jnp.where(keep, inserted_k, layer_k)
+        layer_v = jnp.where(keep, inserted_v, layer_v)
+    else:
+        layer_k, layer_v = inserted_k, inserted_v
+
+    # GQA: expand KV heads to H by repeat.
+    group = H // KV
+    k_all = jnp.repeat(layer_k, group, axis=2)      # [B, S, H, Dh]
+    v_all = jnp.repeat(layer_v, group, axis=2)
+
+    qf = q.astype(jnp.float32)
+    scores = jnp.einsum("bthd,bshd->bhts", qf, k_all.astype(jnp.float32))
+    scores = scores / jnp.sqrt(jnp.asarray(Dh, jnp.float32))
+
+    # Mask: key position s is visible to query t iff s <= lengths + t.
+    q_pos = lengths[:, None] + jnp.arange(T)[None, :]          # [B, T]
+    s_idx = jnp.arange(S)[None, None, :]                        # [1, 1, S]
+    visible = s_idx <= q_pos[:, :, None]                        # [B, T, S]
+    if active is not None:
+        visible = visible & active[:, None, None]
+    scores = jnp.where(visible[:, None, :, :], scores, -1e30)
+
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bshd->bthd", probs, v_all.astype(jnp.float32))
+    return out.reshape(B, T, H * Dh).astype(q.dtype), layer_k, layer_v
+
+
+def swiglu_mlp(x: jax.Array, wg: jax.Array, wu: jax.Array,
+               wd: jax.Array) -> jax.Array:
+    gate = jax.nn.silu(x @ wg)
+    return (gate * (x @ wu)) @ wd
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def forward(params: Params, config: ModelConfig, tokens: jax.Array,
+            lengths: jax.Array, cache: KVCache,
+            active: jax.Array | None = None,
+            attention_fn: Callable = dense_cache_attention,
+            mlp_fn: Callable | None = None,
+            ) -> tuple[jax.Array, KVCache]:
+    """One forward pass over new tokens (prefill chunk or single decode step).
+
+    tokens:  [B, T] int32 — new token ids.
+    lengths: [B] int32 — tokens already in the cache per slot.
+    active:  [B] bool — mask for live batch slots (padding slots compute but
+             can't corrupt anything; their cache rows are reset on admit).
+    Returns (logits [B, T, V] fp32, updated cache).
+    """
+    c = config
+    B, T = tokens.shape
+    dh = c.head_dim
+
+    x = jnp.take(params["embed"], tokens, axis=0)   # [B, T, D]
+
+    positions = lengths[:, None] + jnp.arange(T)[None, :]       # [B, T]
+    cos, sin = rope_tables(positions, dh, c.rope_theta)
+
+    layer_params = params["layers"]
+    custom_mlp = mlp_fn
+
+    def layer_step(x, scanned):
+        lp, layer_k, layer_v = scanned
+        # Attention block
+        h = rms_norm(x, lp["attn_norm"], c.rms_eps)
+        q = (h @ lp["wq"]).reshape(B, T, c.n_heads, dh)
+        k = (h @ lp["wk"]).reshape(B, T, c.n_kv_heads, dh)
+        v = (h @ lp["wv"]).reshape(B, T, c.n_kv_heads, dh)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        attn, layer_k, layer_v = attention_fn(
+            q, k, v, layer_k, layer_v, lengths, active)
+        x = x + attn @ lp["wo"]
+        # MLP block
+        h = rms_norm(x, lp["mlp_norm"], c.rms_eps)
+        if custom_mlp is not None:
+            x = x + custom_mlp(h, lp)
+        else:
+            x = x + swiglu_mlp(h, lp["wg"], lp["wu"], lp["wd"])
+        return x, (layer_k, layer_v)
+
+    x, (new_k, new_v) = jax.lax.scan(
+        layer_step, x, (layer_params, cache.k, cache.v))
+
+    x = rms_norm(x, params["final_norm"], c.rms_eps)
+    head = params["embed"] if c.tie_embeddings else params["lm_head"]
+    logits = (x.astype(jnp.float32) @ head.astype(jnp.float32).T)
+    return logits, KVCache(k=new_k, v=new_v)

@@ -1,0 +1,5 @@
+from .mesh import build_mesh, MeshSpec
+from .sharding import param_shardings, cache_sharding, batch_sharding
+
+__all__ = ["build_mesh", "MeshSpec", "param_shardings", "cache_sharding",
+           "batch_sharding"]

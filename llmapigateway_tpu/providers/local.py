@@ -1,0 +1,224 @@
+"""`local` provider: the in-process TPU engine behind the standard provider
+contract.
+
+This is the BASELINE.json north star — ``/v1/chat/completions`` answered by
+an in-process JAX/XLA engine with **no remote call in the loop**, while
+staying "just another entry in providers.json": same ``(response, error)``
+contract as remote providers, so fallback/rotation/usage plumbing applies
+unchanged, and engine overload/failure falls back to remote providers
+(BASELINE config 5).
+
+Streaming commits only after the first token exists (prefill admission +
+first sample) — the local analog of the remote SSE priming trick
+(SURVEY.md §7 hard part (3)).
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+import uuid
+from typing import Any, AsyncIterator
+
+from ..config.schemas import ProviderDetails
+from ..utils.sse import SSE_DONE, format_sse
+from .base import (
+    CompletionError,
+    CompletionRequest,
+    CompletionResult,
+    JSONCompletion,
+    Provider,
+    StreamingCompletion,
+    UsageObserver,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class LocalProvider(Provider):
+    type = "local"
+
+    def __init__(self, name: str, engine: "InferenceEngine"):
+        self.name = name
+        self.engine = engine
+
+    # -- request translation ---------------------------------------------------
+    def _build_genrequest(self, payload: dict[str, Any]):
+        from ..engine.engine import GenRequest
+        tok = self.engine.tokenizer
+        messages = payload.get("messages") or []
+        if not isinstance(messages, list):
+            raise ValueError("'messages' must be a list")
+        prompt_text = tok.apply_chat_template(messages,
+                                              add_generation_prompt=True)
+        prompt_ids = tok.encode(prompt_text)
+        if tok.bos_id is not None and (not prompt_ids or
+                                       prompt_ids[0] != tok.bos_id):
+            prompt_ids = [tok.bos_id] + prompt_ids
+
+        stop = payload.get("stop") or []
+        if isinstance(stop, str):
+            stop = [stop]
+        max_tokens = int(payload.get("max_completion_tokens")
+                         or payload.get("max_tokens")
+                         or self.engine.cfg.max_tokens_default)
+        temperature = float(payload.get("temperature", 0.0) or 0.0)
+        top_p = float(payload.get("top_p", 1.0) or 1.0)
+        top_k = int(payload.get("top_k", 0) or 0)
+        return GenRequest(prompt_ids=prompt_ids, max_tokens=max_tokens,
+                          temperature=temperature, top_p=top_p, top_k=top_k,
+                          stop=[s for s in stop if s])
+
+    def _usage(self, req) -> dict[str, Any]:
+        n_gen = len(req.generated)
+        usage = {"prompt_tokens": len(req.prompt_ids),
+                 "completion_tokens": n_gen,
+                 "total_tokens": len(req.prompt_ids) + n_gen}
+        if req.t_first_token is not None:
+            usage["ttft_ms"] = round(
+                (req.t_first_token - req.t_submit) * 1000.0, 2)
+            if req.t_done and n_gen > 1 and req.t_done > req.t_first_token:
+                usage["tokens_per_sec"] = round(
+                    (n_gen - 1) / (req.t_done - req.t_first_token), 2)
+        return usage
+
+    # -- the provider contract -------------------------------------------------
+    async def complete(self, request: CompletionRequest,
+                       observer: UsageObserver) -> CompletionResult:
+        from ..engine.engine import EngineOverloaded
+        payload = request.payload
+        model_name = str(payload.get("model", self.name))
+        try:
+            req = self._build_genrequest(payload)
+        except Exception as e:
+            return None, CompletionError(f"invalid request for local engine: {e}",
+                                         retryable=False)
+        try:
+            await self.engine.submit(req)
+        except EngineOverloaded as e:
+            # Overload is a *failable provider* condition: the router falls
+            # back to the next (e.g. remote) target — SURVEY.md §5.
+            return None, CompletionError(str(e), status=503)
+        except Exception as e:
+            logger.exception("engine submit failed")
+            return None, CompletionError(f"local engine error: {e}")
+
+        # Wait for the first delta before committing (priming analog): if the
+        # engine fails before producing a token, the router can still fall back.
+        stream_iter = self.engine.stream(req)
+        try:
+            first_delta = await anext(stream_iter)
+        except StopAsyncIteration:
+            return None, CompletionError("engine produced no output")
+        if first_delta.error is not None:
+            return None, CompletionError(first_delta.error)
+
+        observer.on_first_token()
+
+        if request.stream:
+            frames = self._sse_frames(req, stream_iter, first_delta,
+                                      model_name, observer)
+            return StreamingCompletion(frames=frames, provider=self.name,
+                                       model=model_name), None
+
+        # Non-streaming: drain (cancel the engine work if the handler task is
+        # cancelled, e.g. the client disconnected while we generate).
+        text_parts = [first_delta.text]
+        finish = first_delta.finish_reason
+        error = first_delta.error
+        try:
+            if finish is None and error is None:
+                async for delta in stream_iter:
+                    text_parts.append(delta.text)
+                    finish = delta.finish_reason
+                    error = delta.error
+        except asyncio.CancelledError:
+            req.cancelled = True
+            raise
+        if error is not None:
+            observer.on_stream_end(error)
+            return None, CompletionError(error)
+        text = "".join(text_parts)
+        usage = self._usage(req)
+        observer.on_content_delta(text)
+        observer.on_usage(usage)
+        observer.on_stream_end()
+        body = {
+            "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": model_name,
+            "choices": [{"index": 0,
+                         "message": {"role": "assistant", "content": text},
+                         "finish_reason": finish or "stop"}],
+            "usage": usage,
+        }
+        return JSONCompletion(data=body, provider=self.name,
+                              model=model_name), None
+
+    async def _sse_frames(self, req, stream_iter: AsyncIterator,
+                          first_delta, model_name: str,
+                          observer: UsageObserver) -> AsyncIterator[bytes]:
+        cid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
+        created = int(time.time())
+
+        def chunk(delta_content: str | None, finish: str | None = None,
+                  role: str | None = None, usage: dict | None = None) -> bytes:
+            delta: dict[str, Any] = {}
+            if role:
+                delta["role"] = role
+            if delta_content:
+                delta["content"] = delta_content
+            body: dict[str, Any] = {
+                "id": cid, "object": "chat.completion.chunk",
+                "created": created, "model": model_name,
+                "choices": [{"index": 0, "delta": delta,
+                             "finish_reason": finish}]}
+            if usage is not None:
+                body["usage"] = usage
+            return format_sse(body)
+
+        error: str | None = None
+        try:
+            yield chunk(None, role="assistant")
+            if first_delta.text:
+                observer.on_content_delta(first_delta.text)
+                yield chunk(first_delta.text)
+            finish = first_delta.finish_reason
+            if finish is None:
+                async for delta in stream_iter:
+                    if delta.error is not None:
+                        error = delta.error
+                        yield format_sse({"error": {"message": error,
+                                                    "provider": self.name}})
+                        return
+                    if delta.text:
+                        observer.on_content_delta(delta.text)
+                        yield chunk(delta.text)
+                    if delta.finish_reason is not None:
+                        finish = delta.finish_reason
+            usage = self._usage(req)
+            observer.on_usage(usage)
+            yield chunk(None, finish=finish or "stop", usage=usage)
+            yield format_sse(SSE_DONE)
+        finally:
+            if req.finish_reason is None:
+                # Client hung up mid-stream (generator closed early): tell
+                # the engine to stop decoding and free the slot.
+                req.cancelled = True
+            observer.on_stream_end(error)
+
+    async def list_models(self) -> list[dict[str, Any]] | None:
+        return [{"id": self.name, "object": "model", "owned_by": "local_tpu",
+                 "context_length": self.engine.S}]
+
+    async def close(self) -> None:
+        await self.engine.stop()
+
+
+def make_local_provider(name: str, details: ProviderDetails) -> LocalProvider:
+    """Factory installed into the ProviderRegistry (server/app.py)."""
+    from ..engine.engine import InferenceEngine
+    assert details.engine is not None
+    engine = InferenceEngine(details.engine)
+    return LocalProvider(name, engine)

@@ -1,8 +1,12 @@
 """Test configuration.
 
 JAX runs on 8 virtual CPU devices (the standard trick for exercising
-multi-chip mesh/collective code without TPU hardware — SURVEY.md §4c). Must
-be set before any jax import, hence here at conftest import time.
+multi-chip mesh/collective code without TPU hardware — SURVEY.md §4c). The
+XLA flag must be set before any jax import, hence here at conftest import
+time. NOTE: in this environment the TPU ('axon') platform registers even
+with JAX_PLATFORMS=cpu, so tests additionally pin jax_default_device to a
+host CPU device — otherwise "CPU tests" silently run on the real chip (with
+bf16 default matmul precision, which breaks fp32 numerics comparisons).
 """
 import os
 
@@ -11,6 +15,20 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+import jax  # noqa: E402
+
+# The axon (TPU tunnel) plugin registers itself via sitecustomize and forces
+# jax_platforms="axon,cpu", overriding the env var. Backends initialize
+# lazily, so overriding the *config* back to cpu before any jax.devices()
+# call keeps the test process entirely off the TPU (and immune to tunnel
+# outages).
+jax.config.update("jax_platforms", "cpu")
+
+
+def cpu_devices():
+    """The 8 virtual CPU devices for mesh tests."""
+    return jax.devices("cpu")
+
 import asyncio          # noqa: E402
 import inspect          # noqa: E402
 from pathlib import Path  # noqa: E402
@@ -18,13 +36,26 @@ from pathlib import Path  # noqa: E402
 import pytest           # noqa: E402
 
 
+_loop = None
+
+
+def _shared_loop():
+    """One persistent event loop for every async test — long-lived objects
+    (the engine's batching loop, queues, events) stay bound to a live loop
+    across tests, matching the single-loop production process."""
+    global _loop
+    if _loop is None or _loop.is_closed():
+        _loop = asyncio.new_event_loop()
+    return _loop
+
+
 def pytest_pyfunc_call(pyfuncitem):
-    """Run ``async def`` tests via asyncio.run (no pytest-asyncio here)."""
+    """Run ``async def`` tests on the shared loop (no pytest-asyncio here)."""
     func = pyfuncitem.obj
     if inspect.iscoroutinefunction(func):
         kwargs = {name: pyfuncitem.funcargs[name]
                   for name in pyfuncitem._fixtureinfo.argnames}
-        asyncio.run(func(**kwargs))
+        _shared_loop().run_until_complete(func(**kwargs))
         return True
     return None
 

@@ -1,0 +1,137 @@
+"""E2E: the local JAX engine served through /v1/chat/completions — the
+BASELINE "aha" slice (config 1): no remote call in the loop, plus engine
+overload falling back to a remote provider (config 5 semantics)."""
+import json
+
+import jax
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llmapigateway_tpu.config.loader import ConfigLoader
+from llmapigateway_tpu.config.schemas import ProviderDetails
+from llmapigateway_tpu.config.settings import Settings
+from llmapigateway_tpu.providers.local import LocalProvider, make_local_provider
+from llmapigateway_tpu.server.app import GatewayApp, build_app
+from tests.fake_upstream import FakeUpstream
+
+
+@pytest.fixture(scope="module")
+def local_factory():
+    """Build the engine once per module (compile cache)."""
+    cache = {}
+
+    def factory(name: str, details: ProviderDetails) -> LocalProvider:
+        if name not in cache:
+            from llmapigateway_tpu.engine.engine import InferenceEngine
+            engine = InferenceEngine(details.engine,
+                                     devices=[jax.devices("cpu")[0]])
+            cache[name] = engine
+        return LocalProvider(name, cache[name])
+
+    return factory
+
+
+class LocalGateway:
+    def __init__(self, tmp_path, local_factory, with_backup=False):
+        self.tmp_path = tmp_path
+        self.local_factory = local_factory
+        self.with_backup = with_backup
+
+    async def __aenter__(self):
+        providers = [
+            {"tpu": {"type": "local",
+                     "engine": {"preset": "tiny-test", "dtype": "float32",
+                                "max_batch_size": 2, "max_seq_len": 128,
+                                "prefill_chunk": 32,
+                                "max_tokens_default": 8}}}]
+        rules = [{"gateway_model_name": "gw/local-model",
+                  "fallback_models": [{"provider": "tpu", "model": "tiny-test"}]}]
+        self.upstream = None
+        self.upstream_server = None
+        if self.with_backup:
+            self.upstream = FakeUpstream()
+            self.upstream_server = TestServer(self.upstream.app)
+            await self.upstream_server.start_server()
+            providers.append({"backup": {
+                "baseUrl": f"http://{self.upstream_server.host}:"
+                           f"{self.upstream_server.port}/v1",
+                "apikey": "BK"}})
+            rules[0]["fallback_models"].append(
+                {"provider": "backup", "model": "real-b"})
+        (self.tmp_path / "providers.json").write_text(json.dumps(providers))
+        (self.tmp_path / "models_fallback_rules.json").write_text(
+            json.dumps(rules))
+
+        settings = Settings(fallback_provider="tpu", base_dir=self.tmp_path,
+                            config_dir=self.tmp_path,
+                            db_dir=self.tmp_path / "db",
+                            logs_dir=self.tmp_path / "logs")
+        loader = ConfigLoader(self.tmp_path, fallback_provider=None)
+        self.gw = GatewayApp(settings, loader, local_factory=self.local_factory)
+        app = build_app(settings, loader, gateway=self.gw)
+        self.client = TestClient(TestServer(app))
+        await self.client.start_server()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.client.close()
+        if self.upstream_server:
+            await self.upstream_server.close()
+
+
+async def test_local_nonstreaming(tmp_path, local_factory):
+    async with LocalGateway(tmp_path, local_factory) as g:
+        resp = await g.client.post("/v1/chat/completions", json={
+            "model": "gw/local-model", "max_tokens": 6,
+            "messages": [{"role": "user", "content": "hello"}]})
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["object"] == "chat.completion"
+        assert body["choices"][0]["finish_reason"] in ("stop", "length")
+        usage = body["usage"]
+        assert usage["prompt_tokens"] > 0
+        assert 1 <= usage["completion_tokens"] <= 6
+        assert "ttft_ms" in usage
+
+
+async def test_local_streaming_sse(tmp_path, local_factory):
+    async with LocalGateway(tmp_path, local_factory) as g:
+        resp = await g.client.post("/v1/chat/completions", json={
+            "model": "gw/local-model", "stream": True, "max_tokens": 6,
+            "messages": [{"role": "user", "content": "hello"}]})
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        frames = []
+        async for line in resp.content:
+            line = line.decode().strip()
+            if line.startswith("data: "):
+                frames.append(line[6:])
+        assert frames[-1] == "[DONE]"
+        chunks = [json.loads(f) for f in frames[:-1]]
+        assert chunks[0]["choices"][0]["delta"].get("role") == "assistant"
+        assert chunks[0]["object"] == "chat.completion.chunk"
+        # Final chunk carries finish_reason + usage.
+        assert chunks[-1]["choices"][0]["finish_reason"] in ("stop", "length")
+        assert "usage" in chunks[-1]
+
+
+async def test_local_overload_falls_back_to_remote(tmp_path, local_factory):
+    """Engine refuses (prompt too long) → router falls back to the remote
+    provider; the client still gets 200 (BASELINE config 5 story)."""
+    async with LocalGateway(tmp_path, local_factory, with_backup=True) as g:
+        resp = await g.client.post("/v1/chat/completions", json={
+            "model": "gw/local-model", "max_tokens": 4,
+            "messages": [{"role": "user", "content": "y" * 500}]})
+        assert resp.status == 200
+        body = await resp.json()
+        # Served by the fake remote upstream, not the engine.
+        assert body["choices"][0]["message"]["content"] == "Hello world!"
+        assert len(g.upstream.requests) == 1
+
+
+async def test_local_appears_in_models(tmp_path, local_factory):
+    async with LocalGateway(tmp_path, local_factory) as g:
+        resp = await g.client.get("/v1/models")
+        data = (await resp.json())["data"]
+        ids = [m["id"] for m in data]
+        assert "gw/local-model" in ids

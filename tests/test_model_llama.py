@@ -1,0 +1,120 @@
+"""Model-level tests: forward pass shapes, cache consistency, invariance of
+chunked prefill, GQA, and decode-vs-full-context equivalence."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llmapigateway_tpu.models import llama
+from llmapigateway_tpu.models.config import get_preset
+
+
+@pytest.fixture(scope="module")
+def setup():
+    cfg = get_preset("tiny-test")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    return cfg, params
+
+
+def test_forward_shapes(setup):
+    cfg, params = setup
+    B, T, S = 2, 8, 32
+    cache = llama.KVCache.create(cfg, B, S, dtype=jnp.float32)
+    tokens = jnp.arange(B * T, dtype=jnp.int32).reshape(B, T) % cfg.vocab_size
+    lengths = jnp.zeros((B,), jnp.int32)
+    logits, cache2 = llama.forward(params, cfg, tokens, lengths, cache)
+    assert logits.shape == (B, T, cfg.vocab_size)
+    assert logits.dtype == jnp.float32
+    assert cache2.k.shape == (cfg.n_layers, B, S, cfg.n_kv_heads, cfg.head_dim)
+    assert bool(jnp.all(jnp.isfinite(logits)))
+
+
+def test_chunked_prefill_matches_full(setup):
+    """Prefilling in chunks must produce the same final logits as one pass."""
+    cfg, params = setup
+    S = 64
+    ids = np.array([jax.random.randint(jax.random.PRNGKey(1), (20,), 0,
+                                       cfg.vocab_size)])[0]
+    tokens = jnp.asarray(ids, jnp.int32)[None, :]
+
+    # One-shot prefill.
+    cache_a = llama.KVCache.create(cfg, 1, S, dtype=jnp.float32)
+    logits_a, cache_a = llama.forward(
+        params, cfg, tokens, jnp.zeros((1,), jnp.int32), cache_a)
+
+    # Two-chunk prefill (12 + 8).
+    cache_b = llama.KVCache.create(cfg, 1, S, dtype=jnp.float32)
+    _, cache_b = llama.forward(
+        params, cfg, tokens[:, :12], jnp.zeros((1,), jnp.int32), cache_b)
+    logits_b, cache_b = llama.forward(
+        params, cfg, tokens[:, 12:], jnp.full((1,), 12, jnp.int32), cache_b)
+
+    np.testing.assert_allclose(np.asarray(logits_a[0, -1]),
+                               np.asarray(logits_b[0, -1]), rtol=2e-4, atol=2e-4)
+    np.testing.assert_allclose(np.asarray(cache_a.k[:, :, :20]),
+                               np.asarray(cache_b.k[:, :, :20]),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_decode_matches_prefill_logits(setup):
+    """Greedy decode step on cached context == full forward's next-token
+    logits at that position (the prefill/decode program-pair consistency the
+    whole serving design rests on)."""
+    cfg, params = setup
+    S = 64
+    key = jax.random.PRNGKey(2)
+    ids = jax.random.randint(key, (10,), 0, cfg.vocab_size)
+
+    # Full forward over 10 tokens: logits at position 9 predict token 10.
+    cache_full = llama.KVCache.create(cfg, 1, S, dtype=jnp.float32)
+    logits_full, _ = llama.forward(
+        params, cfg, ids[None, :], jnp.zeros((1,), jnp.int32), cache_full)
+    want = np.asarray(logits_full[0, -1])
+
+    # Prefill 9 tokens, then decode token 9 as a single step.
+    cache = llama.KVCache.create(cfg, 1, S, dtype=jnp.float32)
+    _, cache = llama.forward(
+        params, cfg, ids[None, :9], jnp.zeros((1,), jnp.int32), cache)
+    logits_step, _ = llama.forward(
+        params, cfg, ids[None, 9:10], jnp.full((1,), 9, jnp.int32), cache,
+        active=jnp.ones((1,), bool))
+    got = np.asarray(logits_step[0, 0])
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_padding_tokens_do_not_corrupt(setup):
+    """Pad tokens beyond the true length must not change real logits (the
+    bucketed-prefill invariant)."""
+    cfg, params = setup
+    S = 64
+    ids = jax.random.randint(jax.random.PRNGKey(3), (6,), 0, cfg.vocab_size)
+    cache_a = llama.KVCache.create(cfg, 1, S, dtype=jnp.float32)
+    logits_a, _ = llama.forward(
+        params, cfg, ids[None, :], jnp.zeros((1,), jnp.int32), cache_a)
+
+    padded = jnp.concatenate([ids, jnp.zeros((10,), jnp.int32)])[None, :]
+    cache_b = llama.KVCache.create(cfg, 1, S, dtype=jnp.float32)
+    logits_b, _ = llama.forward(
+        params, cfg, padded, jnp.zeros((1,), jnp.int32), cache_b)
+    np.testing.assert_allclose(np.asarray(logits_a[0, 5]),
+                               np.asarray(logits_b[0, 5]), rtol=2e-4, atol=2e-4)
+
+
+def test_inactive_rows_not_written(setup):
+    cfg, params = setup
+    B, S = 2, 32
+    cache = llama.KVCache.create(cfg, B, S, dtype=jnp.float32)
+    marker = cache.k.at[:, 1].set(7.0)
+    cache = llama.KVCache(k=marker, v=cache.v)
+    tokens = jnp.ones((B, 1), jnp.int32)
+    active = jnp.array([True, False])
+    _, cache2 = llama.forward(params, cfg, tokens,
+                              jnp.zeros((B,), jnp.int32), cache, active=active)
+    # Row 1 (inactive) untouched; row 0 got new values at position 0.
+    assert bool(jnp.all(cache2.k[:, 1] == 7.0))
+    assert not bool(jnp.all(cache2.k[:, 0, 0] == 0.0))
+
+
+def test_gqa_head_counts(setup):
+    cfg, _ = setup
+    assert cfg.n_heads % cfg.n_kv_heads == 0

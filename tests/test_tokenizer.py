@@ -1,0 +1,73 @@
+"""Tokenizer + incremental UTF-8-safe detokenization tests."""
+from llmapigateway_tpu.engine.tokenizer import (
+    ByteTokenizer, IncrementalDetokenizer, load_tokenizer)
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer(512)
+    text = "hello wörld €100 日本語"
+    ids = tok.encode(text)
+    assert tok.decode(ids) == text
+
+
+def test_incremental_detok_ascii():
+    tok = ByteTokenizer(512)
+    detok = IncrementalDetokenizer(tok)
+    out = "".join(detok.push(i) for i in tok.encode("abc"))
+    assert out + detok.flush() == "abc"
+
+
+def test_incremental_detok_multibyte_split():
+    """A multi-byte character split across tokens must not emit garbage."""
+    tok = ByteTokenizer(512)
+    detok = IncrementalDetokenizer(tok)
+    ids = tok.encode("€")          # 3 UTF-8 bytes
+    assert detok.push(ids[0]) == ""          # incomplete → buffered
+    assert detok.push(ids[1]) == ""
+    assert detok.push(ids[2]) == "€"         # completed
+    assert detok.flush() == ""
+
+
+def test_incremental_detok_mixed_stream():
+    tok = ByteTokenizer(512)
+    detok = IncrementalDetokenizer(tok)
+    text = "a€b日c"
+    got = "".join(detok.push(i) for i in tok.encode(text)) + detok.flush()
+    assert got == text
+
+
+def test_incremental_detok_truncated_tail():
+    """Stream ending mid-character: flush must not lose the prefix."""
+    tok = ByteTokenizer(512)
+    detok = IncrementalDetokenizer(tok)
+    ids = tok.encode("ab€")[:-1]     # drop the euro's last byte
+    out = "".join(detok.push(i) for i in ids)
+    assert out == "ab"
+    tail = detok.flush()             # partial char → replacement, not crash
+    assert tail in ("", "�", "�")
+
+
+def test_chat_template_fallback():
+    tok = ByteTokenizer(512)
+    text = tok.apply_chat_template(
+        [{"role": "system", "content": "be nice"},
+         {"role": "user", "content": "hi"}])
+    assert "be nice" in text and "hi" in text
+    assert text.endswith("<|assistant|>\n")
+
+
+def test_chat_template_typed_content_parts():
+    tok = ByteTokenizer(512)
+    text = tok.apply_chat_template(
+        [{"role": "user", "content": [
+            {"type": "text", "text": "part1 "},
+            {"type": "image_url", "image_url": {"url": "x"}},
+            {"type": "text", "text": "part2"}]}])
+    assert "part1 part2" in text
+
+
+def test_load_tokenizer_fallback(tmp_path):
+    tok = load_tokenizer(None, vocab_size=512)
+    assert isinstance(tok, ByteTokenizer)
+    tok = load_tokenizer(tmp_path, vocab_size=512)   # no tokenizer.json
+    assert isinstance(tok, ByteTokenizer)
