@@ -35,11 +35,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..config.schemas import LocalEngineConfig
-from ..models import llama
+from ..models import forward_fn, init_fn, llama
 from ..models.config import ModelConfig, get_preset
 from ..parallel.mesh import MeshSpec, build_mesh
-from ..parallel.sharding import (
-    batch_sharding, cache_sharding, param_shardings, replicated)
+from ..parallel.sharding import cache_sharding, param_shardings
 from .sampling import SamplingParams, sample
 from .tokenizer import IncrementalDetokenizer, load_tokenizer
 
@@ -144,7 +143,7 @@ class InferenceEngine:
                                           dtype=self.dtype, put=put)
         else:
             key = jax.random.PRNGKey(0)
-            host_params = llama.init_params(c, key, dtype=self.dtype)
+            host_params = init_fn(c)(c, key, dtype=self.dtype)
             shardings = param_shardings(host_params, self.mesh)
             self.params = jax.tree.map(jax.device_put, host_params, shardings)
         n_params = sum(int(np.prod(p.shape))
@@ -177,7 +176,7 @@ class InferenceEngine:
 
     def _compile(self) -> None:
         c = self.model_cfg
-        mesh = self.mesh
+        model_forward = forward_fn(c)
 
         @partial(jax.jit, donate_argnums=(1,))
         def prefill_step(params, cache: llama.KVCache, tokens: jax.Array,
@@ -189,7 +188,7 @@ class InferenceEngine:
             v_row = jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1)
             row_cache = llama.KVCache(k=k_row, v=v_row)
             lengths = start_len[None]
-            logits, row_cache = llama.forward(
+            logits, row_cache = model_forward(
                 params, c, tokens, lengths, row_cache)
             new_k = jax.lax.dynamic_update_slice_in_dim(
                 cache.k, row_cache.k, slot, axis=1)
@@ -206,7 +205,7 @@ class InferenceEngine:
             the token/length feedback loop stays ON DEVICE across steps —
             host fetches happen asynchronously, steps behind (the tunnel's
             per-fetch latency is ~40 ms; chained dispatch amortizes it)."""
-            logits, cache = llama.forward(
+            logits, cache = model_forward(
                 params, c, tokens[:, None], lengths, cache, active=active)
             next_tokens = sample(logits[:, 0, :], samp, key)
             new_lengths = jnp.where(active, lengths + 1, lengths)
@@ -237,6 +236,13 @@ class InferenceEngine:
         if self._loop_task is not None:
             await self._loop_task
             self._loop_task = None
+        # Flush terminal deltas so no consumer awaits a stream forever.
+        for req in list(self._running.values()):
+            req.out_queue.put_nowait(Delta(error="engine stopped"))
+            self._release(req)
+        while not self._queue.empty():
+            req = self._queue.get_nowait()
+            req.out_queue.put_nowait(Delta(error="engine stopped"))
 
     async def submit(self, req: GenRequest) -> None:
         """Admit a request; raises EngineOverloaded when the queue is full."""
@@ -267,6 +273,10 @@ class InferenceEngine:
     async def _run_loop(self) -> None:
         logger.info("engine loop started (B=%d, S=%d)", self.B, self.S)
         while not self._stopped:
+            # Clear BEFORE stepping: a submit() that lands during the await
+            # inside _step sets the event and must not be wiped afterwards
+            # (missed-wakeup race — the request would strand in the queue).
+            self._work_event.clear()
             try:
                 progressed = await self._step()
             except Exception as e:           # engine must never die silently
@@ -274,9 +284,18 @@ class InferenceEngine:
                 for req in list(self._running.values()):
                     req.out_queue.put_nowait(Delta(error=f"engine failure: {e}"))
                     self._release(req)
+                # donate_argnums may have consumed the cache buffer before
+                # the failure: rebuild device state so the engine recovers
+                # instead of failing every subsequent step on a deleted array.
+                try:
+                    self._init_state()
+                    self._free_slots = list(range(self.B))
+                    self._running.clear()
+                    self._prefilling.clear()
+                except Exception:
+                    logger.exception("engine state re-init failed")
                 progressed = True
             if not progressed:
-                self._work_event.clear()
                 await self._work_event.wait()
         logger.info("engine loop stopped")
 
@@ -343,7 +362,10 @@ class InferenceEngine:
             self.lengths[slot] = 0
             self.active[slot] = False
         chunk = np.asarray(ids[pos:pos + self.prefill_chunk], np.int32)
-        bucket = _bucket(len(chunk), self.prefill_chunk)
+        # Clamp the bucket so pos+bucket never exceeds the cache extent S:
+        # XLA clamps dynamic_update_slice start indices, so an overrunning
+        # padded chunk would silently shift and corrupt earlier KV entries.
+        bucket = min(_bucket(len(chunk), self.prefill_chunk), self.S - pos)
         padded = np.zeros((1, bucket), np.int32)
         padded[:, :len(chunk)] = chunk
         logits, self.cache = self._prefill_fn(
@@ -424,7 +446,7 @@ class InferenceEngine:
         if req.stop:
             idx = -1
             for s in req.stop:
-                found = req.text.find(s, max(0, req.emitted_upto - 0))
+                found = req.text.find(s, req.emitted_upto)
                 if found >= 0 and (idx < 0 or found < idx):
                     idx = found
             if idx >= 0:

@@ -1,0 +1,166 @@
+"""Mixtral-family sparse-MoE decoder as pure JAX functions.
+
+Covers the reference-parity gap called out in SURVEY.md §2b (Expert
+Parallelism, BASELINE config 4: Mixtral-8×7B on v5e-8). The attention/norm
+stack is shared with models/llama.py — only the MLP block differs: a top-k
+router over ``n_experts`` SwiGLU experts.
+
+TPU-first design:
+
+* **Expert weights stacked on a leading expert dim** (``wg/wu/wd:
+  [L, E, D, F]``, router ``[L, D, E]``) so one einsum batches all experts —
+  the expert dim shards on the ``expert`` mesh axis (parallel/sharding.py)
+  and GSPMD inserts the token all-to-all.
+* **Two routing implementations**, both static-shape (no data-dependent
+  shapes, jit-stable):
+  - ``moe_mlp_dense`` — every expert computes every token, combined with
+    the (top-k-masked) router weights. Exact, never drops a token; the
+    right choice for decode steps and small prefill chunks where the MoE
+    FFN is weight-bandwidth-bound anyway (all E experts' weights stream
+    from HBM regardless of routing, so the extra FLOPs ride free on the
+    MXU).
+  - ``moe_mlp_dispatch`` — GShard/Mesh-TensorFlow capacity-based dispatch:
+    one-hot dispatch tensor [N, E, C] built from a cumsum over the routing
+    mask, expert FFN batched over [E, C, D], combine weighted by router
+    probs. FLOPs scale with top-k, not E; tokens past an expert's capacity
+    are dropped (contribute zero), standard for large prefill. Capacity
+    C = ceil(k·N/E · capacity_factor).
+* Router math in fp32 (softmax over the *top-k logits*, matching Mixtral's
+  renormalized top-k semantics).
+
+Checkpoint mapping: engine/checkpoint.py maps HF ``block_sparse_moe.gate``
+→ ``layers.router`` and ``experts.{e}.w1/w3/w2`` → ``wg/wu/wd`` with the
+[L, E, D, F] layout this module consumes.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from . import llama
+from .config import ModelConfig
+
+Params = dict[str, Any]
+
+
+def init_params(config: ModelConfig, key: jax.Array,
+                dtype: jnp.dtype = jnp.bfloat16) -> Params:
+    """Random-init params: llama layout with MoE expert MLPs.
+
+    Layout deltas vs llama.init_params:
+      layers/router [L, D, E]; layers/{wg,wu,wd} gain an expert dim:
+      wg/wu [L, E, D, F], wd [L, E, F, D].
+    """
+    c = config
+    if not c.is_moe:
+        raise ValueError("mixtral.init_params needs n_experts > 0")
+    base_key, moe_key = jax.random.split(key)
+    params = llama.init_params(c, base_key, dtype=dtype)
+    keys = jax.random.split(moe_key, 4)
+    L, E, D, F = c.n_layers, c.n_experts, c.d_model, c.d_ff
+
+    def dense_init(k, *shape):
+        fan_in = shape[-2]
+        scale = 1.0 / math.sqrt(fan_in)
+        return (jax.random.normal(k, shape, dtype=jnp.float32) * scale
+                ).astype(dtype)
+
+    layers = params["layers"]
+    layers["router"] = dense_init(keys[0], L, D, E)
+    layers["wg"] = dense_init(keys[1], L, E, D, F)
+    layers["wu"] = dense_init(keys[2], L, E, D, F)
+    layers["wd"] = dense_init(keys[3], L, E, F, D)
+    return params
+
+
+def route(x_flat: jax.Array, router: jax.Array,
+          k: int) -> jax.Array:
+    """Top-k routing weights. x_flat [N, D], router [D, E] → probs [N, E]
+    (fp32; zero outside each token's top-k; softmax over top-k logits —
+    Mixtral's renormalized semantics)."""
+    logits = x_flat.astype(jnp.float32) @ router.astype(jnp.float32)  # [N, E]
+    top_vals, top_idx = jax.lax.top_k(logits, k)                      # [N, k]
+    top_w = jax.nn.softmax(top_vals, axis=-1)                         # [N, k]
+    onehot = jax.nn.one_hot(top_idx, logits.shape[-1],
+                            dtype=jnp.float32)                        # [N, k, E]
+    return jnp.einsum("nk,nke->ne", top_w, onehot)
+
+
+def moe_mlp_dense(x: jax.Array, lp: Params, config: ModelConfig) -> jax.Array:
+    """All-experts MoE MLP (exact; no capacity drops).
+
+    x [B, T, D]; lp carries this layer's router [D, E], wg/wu [E, D, F],
+    wd [E, F, D]. Returns [B, T, D].
+    """
+    B, T, D = x.shape
+    xf = x.reshape(B * T, D)
+    probs = route(xf, lp["router"], config.experts_per_token)   # [N, E]
+    # Batched expert FFN over the expert dim: [E, N, F].
+    h = jnp.einsum("nd,edf->enf", xf, lp["wg"])
+    u = jnp.einsum("nd,edf->enf", xf, lp["wu"])
+    y = jnp.einsum("enf,efd->end", jax.nn.silu(h) * u, lp["wd"])
+    out = jnp.einsum("end,ne->nd", y.astype(jnp.float32), probs)
+    return out.reshape(B, T, D).astype(x.dtype)
+
+
+def moe_mlp_dispatch(x: jax.Array, lp: Params, config: ModelConfig,
+                     capacity_factor: float = 2.0) -> jax.Array:
+    """Capacity-based dispatch MoE MLP (GShard einsum formulation).
+
+    FLOPs ∝ top-k instead of E; tokens beyond an expert's capacity are
+    dropped (contribute zero to the residual). Static shapes throughout:
+    C depends only on N/E/k/capacity_factor, all compile-time constants.
+    """
+    B, T, D = x.shape
+    N = B * T
+    E, k = config.n_experts, config.experts_per_token
+    C = max(1, math.ceil(k * N / E * capacity_factor))
+    C = min(C, N)
+
+    xf = x.reshape(N, D)
+    probs = route(xf, lp["router"], k)                           # [N, E] fp32
+    mask = probs > 0.0                                           # [N, E]
+    # Position of each token within its expert's queue (1-based), N-major so
+    # earlier tokens win capacity.
+    position = jnp.cumsum(mask.astype(jnp.int32), axis=0) * mask  # [N, E]
+    keep = mask & (position <= C)
+    # One-hot over capacity slots: dispatch [N, E, C].
+    dispatch = (jax.nn.one_hot(position - 1, C, dtype=xf.dtype)
+                * keep[..., None].astype(xf.dtype))
+    combine = dispatch.astype(jnp.float32) * probs[..., None]    # [N, E, C]
+
+    xs = jnp.einsum("nd,nec->ecd", xf, dispatch)                 # [E, C, D]
+    h = jnp.einsum("ecd,edf->ecf", xs, lp["wg"])
+    u = jnp.einsum("ecd,edf->ecf", xs, lp["wu"])
+    ys = jnp.einsum("ecf,efd->ecd", jax.nn.silu(h) * u, lp["wd"])
+    out = jnp.einsum("ecd,nec->nd", ys.astype(jnp.float32), combine)
+    return out.reshape(B, T, D).astype(x.dtype)
+
+
+def make_mlp_fn(config: ModelConfig, dispatch_threshold: int = 64,
+                capacity_factor: float = 2.0):
+    """The ``mlp_fn`` hook for llama.forward: picks dense vs dispatch by
+    (static) shape — decode steps (T==1) and small chunks always run exact
+    dense (capacity drops would silently degrade generation quality under
+    routing imbalance); only long prefill chunks run capacity dispatch."""
+    def mlp_fn(h: jax.Array, lp: Params) -> jax.Array:
+        B, T, _ = h.shape
+        if T == 1 or B * T <= dispatch_threshold:
+            return moe_mlp_dense(h, lp, config)
+        return moe_mlp_dispatch(h, lp, config,
+                                capacity_factor=capacity_factor)
+    return mlp_fn
+
+
+def forward(params: Params, config: ModelConfig, tokens: jax.Array,
+            lengths: jax.Array, cache: llama.KVCache,
+            active: jax.Array | None = None,
+            attention_fn=llama.dense_cache_attention,
+            ) -> tuple[jax.Array, llama.KVCache]:
+    """Mixtral forward = llama forward with the MoE MLP plugged in."""
+    return llama.forward(params, config, tokens, lengths, cache,
+                         active=active, attention_fn=attention_fn,
+                         mlp_fn=make_mlp_fn(config))

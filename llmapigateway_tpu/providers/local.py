@@ -62,7 +62,10 @@ class LocalProvider(Provider):
         max_tokens = int(payload.get("max_completion_tokens")
                          or payload.get("max_tokens")
                          or self.engine.cfg.max_tokens_default)
-        temperature = float(payload.get("temperature", 0.0) or 0.0)
+        # OpenAI default: temperature=1 (sampled) when omitted; an explicit
+        # 0 still means greedy.
+        raw_temp = payload.get("temperature")
+        temperature = 1.0 if raw_temp is None else float(raw_temp)
         top_p = float(payload.get("top_p", 1.0) or 1.0)
         top_k = int(payload.get("top_k", 0) or 0)
         return GenRequest(prompt_ids=prompt_ids, max_tokens=max_tokens,

@@ -69,6 +69,7 @@ class ProviderRegistry:
         self._local_factory = local_factory
         self._cache: dict[str, tuple[str, Provider]] = {}   # name -> (fingerprint, provider)
         self._lock = asyncio.Lock()
+        self._name_locks: dict[str, asyncio.Lock] = {}
         self._retiring: set[asyncio.Task] = set()
 
     async def get(self, name: str) -> Provider | None:
@@ -80,14 +81,27 @@ class ProviderRegistry:
             cached = self._cache.get(name)
             if cached and cached[0] == fingerprint:
                 return cached[1]
-            if cached:
-                # Config changed: in-flight streams may still hold the old
-                # provider's pooled client — close it only after they can
-                # possibly have finished.
-                self._retire(cached[1])
-            provider = self._build(name, details)
+            name_lock = self._name_locks.setdefault(name, asyncio.Lock())
+        # Build outside the registry lock: a local-engine build (checkpoint
+        # load + device_put) takes seconds to minutes and must not stall
+        # requests to other, already-cached providers. The per-name lock
+        # stops two requests double-building the same provider; the build
+        # itself runs in a worker thread so the event loop keeps serving.
+        async with name_lock:
+            async with self._lock:
+                cached = self._cache.get(name)
+                if cached and cached[0] == fingerprint:
+                    return cached[1]
+                if cached:
+                    # Config changed: in-flight streams may still hold the
+                    # old provider's pooled client — close it only after
+                    # they can possibly have finished.
+                    self._retire(cached[1])
+                    del self._cache[name]
+            provider = await asyncio.to_thread(self._build, name, details)
             if provider is not None:
-                self._cache[name] = (fingerprint, provider)
+                async with self._lock:
+                    self._cache[name] = (fingerprint, provider)
             return provider
 
     def _retire(self, provider: Provider) -> None:

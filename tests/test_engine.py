@@ -90,3 +90,54 @@ async def test_sampling_with_temperature(engine):
 def test_stats(engine):
     s = engine.stats()
     assert s["batch_size"] == 4 and s["running"] == 0
+
+
+async def test_prefill_near_cache_boundary_no_overrun():
+    """Regression: with S not a multiple of the prefill bucket, the final
+    padded chunk must be clamped to S - pos — XLA clamps out-of-range
+    dynamic_update_slice starts, which would silently shift the chunk and
+    corrupt earlier KV entries. Greedy decode after a boundary-straddling
+    prompt must match the same prompt run through a roomy engine."""
+    import numpy as np
+    cfg_tight = LocalEngineConfig(preset="tiny-test", max_batch_size=1,
+                                  max_seq_len=100, prefill_chunk=32,
+                                  dtype="float32")
+    cfg_roomy = LocalEngineConfig(preset="tiny-test", max_batch_size=1,
+                                  max_seq_len=256, prefill_chunk=32,
+                                  dtype="float32")
+    dev = [jax.devices("cpu")[0]]
+    prompt_ids = list(np.arange(2, 97).astype(int) % 500)   # 95 tokens:
+    # chunks at pos 0/32/64 → last bucket would pad to 32 but 64+32 = 96 < 100
+    # is fine; use 97 tokens so last chunk starts at 96 with bucket 8 > 100-96.
+    prompt_ids = prompt_ids + [7, 9]                         # 97 tokens
+
+    async def run(cfg):
+        eng = InferenceEngine(cfg, devices=dev)
+        try:
+            req = GenRequest(prompt_ids=list(prompt_ids), max_tokens=2,
+                             temperature=0.0)
+            await eng.submit(req)
+            async for _ in eng.stream(req):
+                pass
+            return req.generated
+        finally:
+            await eng.stop()
+
+    tight = await run(cfg_tight)
+    roomy = await run(cfg_roomy)
+    assert tight[:1] == roomy[:1]     # first token comes straight off prefill
+
+
+async def test_stop_flushes_waiting_consumers():
+    """stop() must emit terminal deltas for queued requests so no consumer
+    hangs (review finding)."""
+    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=1,
+                            max_seq_len=64, prefill_chunk=16, dtype="float32")
+    eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
+    req = GenRequest(prompt_ids=[1, 2, 3], max_tokens=4)
+    # Enqueue without letting the loop run, then stop: the stream must
+    # terminate with an error delta rather than hang.
+    eng._queue.put_nowait(req)
+    await eng.stop()
+    delta = await asyncio.wait_for(req.out_queue.get(), timeout=2)
+    assert delta.error is not None
