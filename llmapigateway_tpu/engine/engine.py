@@ -154,7 +154,7 @@ class InferenceEngine:
     def _init_state(self) -> None:
         c = self.model_cfg
         csh = cache_sharding(self.mesh, c.n_kv_heads, self.B)
-        shape = (c.n_layers, self.B, self.S, c.n_kv_heads, c.head_dim)
+        shape = (c.n_layers, self.B, c.n_kv_heads, self.S, c.head_dim)
         self.cache = llama.KVCache(
             k=jax.device_put(jnp.zeros(shape, self.dtype), csh),
             v=jax.device_put(jnp.zeros(shape, self.dtype), csh))
@@ -176,14 +176,19 @@ class InferenceEngine:
 
     def _compile(self) -> None:
         c = self.model_cfg
-        model_forward = forward_fn(c)
+        family_forward = forward_fn(c)
+        attention_fn = self._pick_attention()
+        if attention_fn is None:
+            model_forward = family_forward
+        else:
+            model_forward = partial(family_forward, attention_fn=attention_fn)
 
         @partial(jax.jit, donate_argnums=(1,))
         def prefill_step(params, cache: llama.KVCache, tokens: jax.Array,
                          start_len: jax.Array, slot: jax.Array
                          ) -> tuple[jax.Array, llama.KVCache]:
             """Run one prompt chunk for one slot. tokens [1, C]."""
-            # Slice this slot's cache rows: [L, 1, S, KV, Dh].
+            # Slice this slot's cache rows: [L, 1, KV, S, Dh].
             k_row = jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1)
             v_row = jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1)
             row_cache = llama.KVCache(k=k_row, v=v_row)
@@ -222,6 +227,22 @@ class InferenceEngine:
         self._prefill_fn = prefill_step
         self._decode_fn = decode_step
         self._sample_one = sample_one
+
+    def _pick_attention(self):
+        """Resolve cfg.attention: "pallas" → flash kernels, "reference" →
+        the jnp path, "auto" → flash on real TPU backends (interpret-mode
+        Pallas on CPU is correct but slower than XLA's fused jnp)."""
+        impl = self.cfg.attention
+        if impl not in ("auto", "pallas", "reference"):
+            raise ValueError(f"unknown attention impl {impl!r}; "
+                             f"expected auto | pallas | reference")
+        if impl == "auto":
+            impl = "pallas" if jax.default_backend() == "tpu" else "reference"
+        if impl == "pallas":
+            from ..ops import make_cache_attention_fn
+            logger.info("attention: pallas flash kernels")
+            return make_cache_attention_fn()
+        return None
 
     # -- public API ----------------------------------------------------------
     async def start(self) -> None:

@@ -111,11 +111,14 @@ def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
 
 
 class KVCache(NamedTuple):
-    """Dense per-slot KV cache, stacked over layers.
+    """Dense per-slot KV cache, stacked over layers, **head-major**.
 
-    k, v: [L, B, S_max, KV, Dh]. ``lengths`` ([B], int32) — tokens already
-    cached per slot — lives in the engine's batch state, not here, so the
-    cache stays a plain pytree of arrays.
+    k, v: [L, B, KV, S_max, Dh] — per-head sequence contiguous, which is
+    the layout the Pallas kernels want (Mosaic blocks tile the last two
+    dims: (seq_block, head_dim) = (8k, 128)-aligned) and gives the jnp
+    path unit-stride reads per head too. ``lengths`` ([B], int32) — tokens
+    already cached per slot — lives in the engine's batch state, not here,
+    so the cache stays a plain pytree of arrays.
     """
     k: jax.Array
     v: jax.Array
@@ -123,10 +126,34 @@ class KVCache(NamedTuple):
     @classmethod
     def create(cls, config: ModelConfig, batch: int, max_seq: int,
                dtype=jnp.bfloat16) -> "KVCache":
-        shape = (config.n_layers, batch, max_seq, config.n_kv_heads,
+        shape = (config.n_layers, batch, config.n_kv_heads, max_seq,
                  config.head_dim)
         return cls(k=jnp.zeros(shape, dtype=dtype),
                    v=jnp.zeros(shape, dtype=dtype))
+
+
+def insert_kv(layer_k: jax.Array, layer_v: jax.Array, k_new: jax.Array,
+              v_new: jax.Array, lengths: jax.Array,
+              active: jax.Array | None) -> tuple[jax.Array, jax.Array]:
+    """Insert new tokens at [lengths, lengths+T) per row of the head-major
+    cache ([B, KV, S, Dh]). T is static; offsets are data — per-row
+    dynamic_update_slice through vmap (XLA lowers this efficiently on TPU).
+    Rows with ``active=False`` are left untouched: their cache is owned by
+    the prefill path. The ONE copy of this layout-sensitive invariant —
+    both the jnp and the Pallas attention paths go through it.
+    """
+    def insert(cache_row, new_row, offset):
+        # cache_row [KV, S, Dh]; new_row [T, KV, Dh] → [KV, T, Dh]
+        return jax.lax.dynamic_update_slice(
+            cache_row, new_row.transpose(1, 0, 2).astype(cache_row.dtype),
+            (0, offset, 0))
+    inserted_k = jax.vmap(insert)(layer_k, k_new, lengths)
+    inserted_v = jax.vmap(insert)(layer_v, v_new, lengths)
+    if active is not None:
+        keep = active[:, None, None, None]
+        inserted_k = jnp.where(keep, inserted_k, layer_k)
+        inserted_v = jnp.where(keep, inserted_v, layer_v)
+    return inserted_k, inserted_v
 
 
 def dense_cache_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
@@ -139,38 +166,24 @@ def dense_cache_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
 
     q:      [B, T, H, Dh] (RoPE already applied)
     k_new:  [B, T, KV, Dh], v_new same — new tokens to insert at `lengths`.
-    layer_k/v: [B, S, KV, Dh] — this layer's cache.
+    layer_k/v: [B, KV, S, Dh] — this layer's cache (head-major).
     lengths: [B] int32 — tokens already cached (insert offset).
     Returns (attn_out [B, T, H*Dh], updated layer_k, layer_v).
     """
     B, T, H, Dh = q.shape
     KV = k_new.shape[2]
-    S = layer_k.shape[1]
+    S = layer_k.shape[2]
 
-    # Insert new tokens at [lengths, lengths+T) per batch row. T is static;
-    # offsets are data — use dynamic_update_slice per row through vmap (XLA
-    # lowers to efficient dynamic-slice on TPU). Inactive rows (slots mid-
-    # prefill or idle during a decode step) must NOT be written: their cache
-    # is owned by the prefill path.
-    def insert(cache_row, new_row, offset):
-        return jax.lax.dynamic_update_slice(
-            cache_row, new_row.astype(cache_row.dtype), (offset, 0, 0))
-    inserted_k = jax.vmap(insert)(layer_k, k_new, lengths)
-    inserted_v = jax.vmap(insert)(layer_v, v_new, lengths)
-    if active is not None:
-        keep = active[:, None, None, None]
-        layer_k = jnp.where(keep, inserted_k, layer_k)
-        layer_v = jnp.where(keep, inserted_v, layer_v)
-    else:
-        layer_k, layer_v = inserted_k, inserted_v
+    layer_k, layer_v = insert_kv(layer_k, layer_v, k_new, v_new,
+                                 lengths, active)
 
     # GQA: expand KV heads to H by repeat.
     group = H // KV
-    k_all = jnp.repeat(layer_k, group, axis=2)      # [B, S, H, Dh]
-    v_all = jnp.repeat(layer_v, group, axis=2)
+    k_all = jnp.repeat(layer_k, group, axis=1)      # [B, H, S, Dh]
+    v_all = jnp.repeat(layer_v, group, axis=1)
 
     qf = q.astype(jnp.float32)
-    scores = jnp.einsum("bthd,bshd->bhts", qf, k_all.astype(jnp.float32))
+    scores = jnp.einsum("bthd,bhsd->bhts", qf, k_all.astype(jnp.float32))
     scores = scores / jnp.sqrt(jnp.asarray(Dh, jnp.float32))
 
     # Mask: key position s is visible to query t iff s <= lengths + t.
@@ -182,7 +195,7 @@ def dense_cache_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     scores = jnp.where(visible[:, None, :, :], scores, -1e30)
 
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhts,bshd->bthd", probs, v_all.astype(jnp.float32))
+    out = jnp.einsum("bhts,bhsd->bthd", probs, v_all.astype(jnp.float32))
     return out.reshape(B, T, H * Dh).astype(q.dtype), layer_k, layer_v
 
 

@@ -1,0 +1,17 @@
+"""TPU-native kernels (Pallas/Mosaic) — this framework's "native tier".
+
+The reference has no native code at all (SURVEY.md §2: 100% Python); here
+the hand-written machine-code tier is Pallas kernels compiled by Mosaic for
+the TPU's MXU/VPU, replacing the hot jnp attention path in models/llama.py.
+"""
+from .flash_attention import (
+    flash_decode_attention,
+    flash_prefill_attention,
+    make_cache_attention_fn,
+)
+
+__all__ = [
+    "flash_decode_attention",
+    "flash_prefill_attention",
+    "make_cache_attention_fn",
+]

@@ -1,0 +1,291 @@
+"""Flash attention over the serving KV cache, as Pallas TPU kernels.
+
+Two kernels cover the two compiled serving programs (engine/engine.py):
+
+* :func:`flash_decode_attention` — one query token per slot against the
+  whole cache. Grid ``(B, KV, S/BS)``; each program block holds one slot's
+  one KV head's key/value block in VMEM. GQA is handled *inside* the
+  kernel (queries arrive grouped ``[B, KV, G, Dh]``), so cache reads are
+  never expanded ``G×`` the way the jnp path's ``jnp.repeat`` does — at
+  serving batch sizes decode attention is pure HBM bandwidth, making this
+  the kernel that sets the tok/s ceiling. Sequence blocks past the slot's
+  live length contribute nothing and are skipped with ``pl.when`` (ragged
+  attention: slots early in their generation don't pay for ``S_max``).
+* :func:`flash_prefill_attention` — a prompt chunk of ``T`` queries against
+  the cache prefix plus itself. Grid ``(B, H, T/TB, S/BS)`` with online
+  softmax over the S blocks; causally-invisible key blocks are skipped
+  entirely, and per-element causal masking handles the block diagonal.
+  Nothing ``[T, S]``-shaped ever hits HBM (the jnp path materializes
+  ``[B, H, T, S]`` scores).
+
+Both kernels accumulate in fp32 scratch (``m``/``l``/``acc`` — the classic
+online-softmax triple) and run in interpret mode off-TPU, so the same code
+path is exercised by the CPU test suite (tests/test_ops_attention.py
+compares against models/llama.py's reference jnp attention).
+
+The :func:`make_cache_attention_fn` wrapper adapts these to the model's
+``attention_fn`` contract (llama.py:132 ``dense_cache_attention``): cache
+insertion stays in XLA (dynamic_update_slice lowers well), the kernels do
+the bandwidth-heavy read.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _cdiv(a: int, b: int) -> int:
+    return (a + b - 1) // b
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ---------------------------------------------------------------------------
+# Decode kernel: q [B, KV, G, Dh] vs cache [B, KV, S, Dh], ragged by n_valid
+# ---------------------------------------------------------------------------
+
+def _decode_kernel(nvalid_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, block_s: int):
+    b = pl.program_id(0)
+    s = pl.program_id(2)
+    n_sb = pl.num_programs(2)
+
+    @pl.when(s == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    n_valid = nvalid_ref[b]
+
+    @pl.when(s * block_s < n_valid)
+    def _block():
+        q = q_ref[0, 0].astype(jnp.float32)            # [G, Dh]
+        k = k_ref[0, 0].astype(jnp.float32)            # [BS, Dh]
+        v = v_ref[0, 0].astype(jnp.float32)            # [BS, Dh]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [G, BS]
+        scores *= q.shape[-1] ** -0.5
+
+        s_global = s * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        scores = jnp.where(s_global < n_valid, scores, NEG_INF)
+
+        m_prev = m_ref[:, :1]                          # [G, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)                # [G, 1]
+        p = jnp.exp(scores - m_new)                    # [G, BS]
+        l_ref[:, :1] = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [G, Dh]
+        m_ref[:, :1] = m_new
+
+    @pl.when(s == n_sb - 1)
+    def _out():
+        l = l_ref[:, :1]
+        o_ref[0, 0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
+                       ).astype(o_ref.dtype)
+
+
+def flash_decode_attention(q: jax.Array, layer_k: jax.Array,
+                           layer_v: jax.Array, n_valid: jax.Array,
+                           *, block_s: int = 128,
+                           interpret: bool | None = None) -> jax.Array:
+    """Ragged single-token attention over an (already updated) cache.
+
+    q: [B, H, Dh] (RoPE applied); layer_k/v: [B, KV, S, Dh] (head-major);
+    n_valid: [B] int32 — visible prefix per slot (query position + 1).
+    Returns [B, H * Dh] in q.dtype.
+    """
+    B, H, Dh = q.shape
+    KV, S = layer_k.shape[1], layer_k.shape[2]
+    G = H // KV
+    block_s = min(block_s, S)
+    if S % block_s:
+        raise ValueError(f"cache extent {S} not a multiple of block {block_s}")
+    qg = q.reshape(B, KV, G, Dh)
+    grid = (B, KV, S // block_s)
+
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, block_s=block_s),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, G, Dh), lambda b, h, s, nv: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, block_s, Dh),
+                             lambda b, h, s, nv: (b, h, s, 0)),
+                pl.BlockSpec((1, 1, block_s, Dh),
+                             lambda b, h, s, nv: (b, h, s, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, G, Dh),
+                                   lambda b, h, s, nv: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((G, 128), jnp.float32),      # m
+                pltpu.VMEM((G, 128), jnp.float32),      # l
+                pltpu.VMEM((G, Dh), jnp.float32),       # acc
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, KV, G, Dh), q.dtype),
+        interpret=_interpret_default() if interpret is None else interpret,
+    )(n_valid.astype(jnp.int32), qg, layer_k, layer_v)
+    return out.reshape(B, H * Dh)
+
+
+# ---------------------------------------------------------------------------
+# Prefill kernel: q [B, T, H, Dh] vs cache [B, KV, S, Dh], causal from start
+# ---------------------------------------------------------------------------
+
+def _prefill_kernel(start_ref, q_ref, k_ref, v_ref, o_ref,
+                    m_ref, l_ref, acc_ref, *, block_t: int, block_s: int):
+    b = pl.program_id(0)
+    t = pl.program_id(2)
+    s = pl.program_id(3)
+    n_sb = pl.num_programs(3)
+
+    @pl.when(s == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    start = start_ref[b]
+    # Query block t covers absolute positions [start + t*TB, start + t*TB +
+    # TB); key block s is (partially) visible iff its first key position is
+    # <= the block's last query position.
+    last_q_pos = start + t * block_t + (block_t - 1)
+
+    @pl.when(s * block_s <= last_q_pos)
+    def _block():
+        q = q_ref[0, 0].astype(jnp.float32)            # [TB, Dh]
+        k = k_ref[0, 0].astype(jnp.float32)            # [BS, Dh]
+        v = v_ref[0, 0].astype(jnp.float32)            # [BS, Dh]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [TB, BS]
+        scores *= q.shape[-1] ** -0.5
+
+        q_pos = start + t * block_t + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 0)
+        s_pos = s * block_s + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        scores = jnp.where(s_pos <= q_pos, scores, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new)
+        l_ref[:, :1] = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:, :1] = m_new
+
+    @pl.when(s == n_sb - 1)
+    def _out():
+        l = l_ref[:, :1]
+        o_ref[0, 0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
+                       ).astype(o_ref.dtype)
+
+
+def flash_prefill_attention(q: jax.Array, layer_k: jax.Array,
+                            layer_v: jax.Array, start: jax.Array,
+                            *, block_t: int = 128, block_s: int = 128,
+                            interpret: bool | None = None) -> jax.Array:
+    """Causal chunk attention over an (already updated) cache.
+
+    q: [B, T, H, Dh] — the chunk's queries at absolute positions
+    ``start + t``; layer_k/v: [B, KV, S, Dh] (head-major) with the chunk's
+    keys already inserted at ``[start, start+T)``; start: [B] int32.
+    Returns [B, T, H * Dh] in q.dtype.
+    """
+    B, T, H, Dh = q.shape
+    KV, S = layer_k.shape[1], layer_k.shape[2]
+    G = H // KV
+    block_t = min(block_t, T)
+    block_s = min(block_s, S)
+    if T % block_t or S % block_s:
+        raise ValueError(f"T={T} / S={S} not multiples of blocks "
+                         f"{block_t}/{block_s}")
+    qh = q.transpose(0, 2, 1, 3)                 # [B, H, T, Dh]
+    grid = (B, H, T // block_t, S // block_s)
+
+    out = pl.pallas_call(
+        functools.partial(_prefill_kernel, block_t=block_t, block_s=block_s),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, block_t, Dh),
+                             lambda b, h, t, s, st: (b, h, t, 0)),
+                pl.BlockSpec((1, 1, block_s, Dh),
+                             lambda b, h, t, s, st: (b, h // G, s, 0)),
+                pl.BlockSpec((1, 1, block_s, Dh),
+                             lambda b, h, t, s, st: (b, h // G, s, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, block_t, Dh),
+                                   lambda b, h, t, s, st: (b, h, t, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((block_t, 128), jnp.float32),   # m
+                pltpu.VMEM((block_t, 128), jnp.float32),   # l
+                pltpu.VMEM((block_t, Dh), jnp.float32),    # acc
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, T, Dh), q.dtype),
+        interpret=_interpret_default() if interpret is None else interpret,
+    )(start.astype(jnp.int32), qh, layer_k, layer_v)
+    return out.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
+
+
+# ---------------------------------------------------------------------------
+# attention_fn adapter (llama.forward contract)
+# ---------------------------------------------------------------------------
+
+
+def _auto_block(n: int, cap: int) -> int:
+    """Largest power-of-two divisor of n, capped — shapes are static at
+    trace time, so each distinct (T, S) picks its own legal blocking (the
+    final prefill bucket can be a non-power-of-two after the cache-extent
+    clamp in engine._prefill_one_chunk)."""
+    b = n & (-n)
+    return min(b, cap)
+
+
+def make_cache_attention_fn(block_s: int | None = None,
+                            block_t: int | None = None,
+                            interpret: bool | None = None):
+    """Build an ``attention_fn`` (llama.py forward contract) backed by the
+    flash kernels: insert in XLA, attend in Pallas. Decode (T==1) takes the
+    GQA-grouped ragged kernel; prefill chunks take the causal kernel.
+    ``block_s``/``block_t`` default to auto (largest pow2 divisor ≤128)."""
+    def attention_fn(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
+        B, T, H, Dh = q.shape
+        S = layer_k.shape[2]
+        from ..models.llama import insert_kv
+        bs = block_s if block_s is not None else _auto_block(S, 128)
+        layer_k, layer_v = insert_kv(layer_k, layer_v, k_new, v_new,
+                                     lengths, active)
+        if T == 1:
+            n_valid = lengths + 1
+            if active is not None:
+                n_valid = jnp.where(active, n_valid, 1)
+            out = flash_decode_attention(
+                q[:, 0], layer_k, layer_v, n_valid,
+                block_s=bs, interpret=interpret)
+            return out[:, None, :], layer_k, layer_v
+        bt = block_t if block_t is not None else _auto_block(T, 128)
+        out = flash_prefill_attention(
+            q, layer_k, layer_v, lengths,
+            block_t=bt, block_s=bs, interpret=interpret)
+        return out, layer_k, layer_v
+    return attention_fn

@@ -93,10 +93,11 @@ def spec_for_param(path: str, shape: tuple[int, ...], mesh: Mesh) -> NamedShardi
 
 
 def cache_sharding(mesh: Mesh, n_kv_heads: int, batch: int) -> NamedSharding:
-    """KV cache [L, B, S, KV, Dh]: batch on data, KV heads on model."""
+    """KV cache [L, B, KV, S, Dh] (head-major): batch on data, KV heads on
+    model."""
     return NamedSharding(mesh, P(
-        None, _axis(mesh, "data", batch), None,
-        _axis(mesh, "model", n_kv_heads), None))
+        None, _axis(mesh, "data", batch),
+        _axis(mesh, "model", n_kv_heads), None, None))
 
 
 def batch_sharding(mesh: Mesh, batch: int) -> NamedSharding:

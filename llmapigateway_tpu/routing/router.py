@@ -71,6 +71,7 @@ class ProviderRegistry:
         self._lock = asyncio.Lock()
         self._name_locks: dict[str, asyncio.Lock] = {}
         self._retiring: set[asyncio.Task] = set()
+        self._closed = False
 
     async def get(self, name: str) -> Provider | None:
         details = self._loader.providers.get(name)
@@ -101,6 +102,11 @@ class ProviderRegistry:
             provider = await asyncio.to_thread(self._build, name, details)
             if provider is not None:
                 async with self._lock:
+                    if self._closed:
+                        # Registry shut down while this build was in flight:
+                        # don't strand a live provider in a dead cache.
+                        await provider.close()
+                        return None
                     self._cache[name] = (fingerprint, provider)
             return provider
 
@@ -129,6 +135,7 @@ class ProviderRegistry:
 
     async def close(self) -> None:
         async with self._lock:
+            self._closed = True
             for task in list(self._retiring):
                 task.cancel()
                 try:

@@ -25,7 +25,7 @@ def test_forward_shapes(setup):
     logits, cache2 = llama.forward(params, cfg, tokens, lengths, cache)
     assert logits.shape == (B, T, cfg.vocab_size)
     assert logits.dtype == jnp.float32
-    assert cache2.k.shape == (cfg.n_layers, B, S, cfg.n_kv_heads, cfg.head_dim)
+    assert cache2.k.shape == (cfg.n_layers, B, cfg.n_kv_heads, S, cfg.head_dim)
     assert bool(jnp.all(jnp.isfinite(logits)))
 
 
