@@ -239,6 +239,13 @@ class InferenceEngine:
         if impl == "auto":
             impl = "pallas" if jax.default_backend() == "tpu" else "reference"
         if impl == "pallas":
+            if self.mesh.size > 1:
+                # Sharded cache → the kernels must run under shard_map
+                # (pallas_call has no GSPMD partitioning rule).
+                from ..ops import make_sharded_cache_attention_fn
+                logger.info("attention: pallas flash kernels (shard_map over "
+                            "%s)", dict(self.mesh.shape))
+                return make_sharded_cache_attention_fn(self.mesh)
             from ..ops import make_cache_attention_fn
             logger.info("attention: pallas flash kernels")
             return make_cache_attention_fn()

@@ -8,10 +8,12 @@ from .flash_attention import (
     flash_decode_attention,
     flash_prefill_attention,
     make_cache_attention_fn,
+    make_sharded_cache_attention_fn,
 )
 
 __all__ = [
     "flash_decode_attention",
     "flash_prefill_attention",
     "make_cache_attention_fn",
+    "make_sharded_cache_attention_fn",
 ]

@@ -9,8 +9,11 @@ Two kernels cover the two compiled serving programs (engine/engine.py):
   never expanded ``G×`` the way the jnp path's ``jnp.repeat`` does — at
   serving batch sizes decode attention is pure HBM bandwidth, making this
   the kernel that sets the tok/s ceiling. Sequence blocks past the slot's
-  live length contribute nothing and are skipped with ``pl.when`` (ragged
-  attention: slots early in their generation don't pay for ``S_max``).
+  live length contribute nothing: their compute is skipped with ``pl.when``
+  AND their HBM→VMEM copies are elided by clamping the K/V block index maps
+  to the last live block (the pipeline skips the DMA when the next block
+  index equals the current one), so slots early in their generation truly
+  don't pay ``S_max`` bandwidth (ragged attention).
 * :func:`flash_prefill_attention` — a prompt chunk of ``T`` queries against
   the cache prefix plus itself. Grid ``(B, H, T/TB, S/BS)`` with online
   softmax over the S blocks; causally-invisible key blocks are skipped
@@ -116,6 +119,13 @@ def flash_decode_attention(q: jax.Array, layer_k: jax.Array,
     qg = q.reshape(B, KV, G, Dh)
     grid = (B, KV, S // block_s)
 
+    def kv_index(b, h, s, nv):
+        # Clamp to the slot's last live block: iterations past n_valid re-
+        # reference the previous block, so the pipeline elides their DMA
+        # (pl.when already skips their compute). n_valid >= 1 always.
+        last = (nv[b] + block_s - 1) // block_s - 1
+        return b, h, jnp.minimum(s, last), 0
+
     out = pl.pallas_call(
         functools.partial(_decode_kernel, block_s=block_s),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -123,10 +133,8 @@ def flash_decode_attention(q: jax.Array, layer_k: jax.Array,
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, 1, G, Dh), lambda b, h, s, nv: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, block_s, Dh),
-                             lambda b, h, s, nv: (b, h, s, 0)),
-                pl.BlockSpec((1, 1, block_s, Dh),
-                             lambda b, h, s, nv: (b, h, s, 0)),
+                pl.BlockSpec((1, 1, block_s, Dh), kv_index),
+                pl.BlockSpec((1, 1, block_s, Dh), kv_index),
             ],
             out_specs=pl.BlockSpec((1, 1, G, Dh),
                                    lambda b, h, s, nv: (b, h, 0, 0)),
@@ -220,6 +228,13 @@ def flash_prefill_attention(q: jax.Array, layer_k: jax.Array,
     qh = q.transpose(0, 2, 1, 3)                 # [B, H, T, Dh]
     grid = (B, H, T // block_t, S // block_s)
 
+    def kv_index(b, h, t, s, st):
+        # Clamp to the last causally-visible key block for query block t —
+        # invisible iterations repeat the previous block index so their
+        # HBM→VMEM copy is elided (compute already skipped by pl.when).
+        last_q_pos = st[b] + t * block_t + (block_t - 1)
+        return b, h // G, jnp.minimum(s, last_q_pos // block_s), 0
+
     out = pl.pallas_call(
         functools.partial(_prefill_kernel, block_t=block_t, block_s=block_s),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -228,10 +243,8 @@ def flash_prefill_attention(q: jax.Array, layer_k: jax.Array,
             in_specs=[
                 pl.BlockSpec((1, 1, block_t, Dh),
                              lambda b, h, t, s, st: (b, h, t, 0)),
-                pl.BlockSpec((1, 1, block_s, Dh),
-                             lambda b, h, t, s, st: (b, h // G, s, 0)),
-                pl.BlockSpec((1, 1, block_s, Dh),
-                             lambda b, h, t, s, st: (b, h // G, s, 0)),
+                pl.BlockSpec((1, 1, block_s, Dh), kv_index),
+                pl.BlockSpec((1, 1, block_s, Dh), kv_index),
             ],
             out_specs=pl.BlockSpec((1, 1, block_t, Dh),
                                    lambda b, h, t, s, st: (b, h, t, 0)),
@@ -288,4 +301,52 @@ def make_cache_attention_fn(block_s: int | None = None,
             q, layer_k, layer_v, lengths,
             block_t=bt, block_s=bs, interpret=interpret)
         return out, layer_k, layer_v
+    return attention_fn
+
+
+def make_sharded_cache_attention_fn(mesh, block_s: int | None = None,
+                                    block_t: int | None = None,
+                                    interpret: bool | None = None):
+    """Mesh-aware ``attention_fn``: the flash kernels under ``shard_map``.
+
+    ``pallas_call`` has no GSPMD partitioning rule, so invoking the kernels
+    inside ``jit`` on mesh-sharded arrays would force XLA to gather the full
+    KV cache onto every chip. Attention is embarrassingly parallel over
+    batch (``data`` axis) and KV heads (``model`` axis — cache_sharding's
+    layout), so we go manual over exactly the axes the shapes allow:
+    ``model`` when heads divide, ``data`` when the batch divides (prefill
+    runs a single slot's [1, ...] row, so batch stays automatic there).
+    Falls back to the unsharded fn when nothing divides (e.g. 1-chip mesh).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    base = make_cache_attention_fn(block_s, block_t, interpret)
+
+    def attention_fn(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
+        B, _, H, _ = q.shape
+        KV = layer_k.shape[1]
+        msize = mesh.shape.get("model", 1)
+        dsize = mesh.shape.get("data", 1)
+        model = "model" if (msize > 1 and KV % msize == 0 and H % msize == 0) \
+            else None
+        data = "data" if (dsize > 1 and B % dsize == 0) else None
+        manual = {ax for ax in (model, data) if ax}
+        if not manual:
+            return base(q, k_new, v_new, layer_k, layer_v, lengths, active)
+
+        head = P(data, None, model, None)       # q / k_new / v_new
+        cache = P(data, model, None, None)      # layer_k / layer_v
+        slot = P(data)                          # lengths / active
+        # `active=None` means "all slots live" — materialize it so the
+        # shard_map signature is static.
+        act = active if active is not None \
+            else jnp.ones((B,), bool)
+        f = jax.shard_map(
+            lambda q_, kn, vn, lk, lv, ln, ac:
+                base(q_, kn, vn, lk, lv, ln, ac),
+            mesh=mesh,
+            in_specs=(head, head, head, cache, cache, slot, slot),
+            out_specs=(P(data, None, model), cache, cache),
+            axis_names=manual, check_vma=False)
+        return f(q, k_new, v_new, layer_k, layer_v, lengths, act)
     return attention_fn

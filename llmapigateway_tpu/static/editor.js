@@ -1,0 +1,323 @@
+/* Config editor SPA logic. Counterpart of the reference's static/editor.js
+   (CodeMirror json5 editor, tabs, load/save via /v1/config/*, pydantic-error
+   rendering, agent-config downloads) — rebuilt dependency-free: a plain
+   textarea with a line-number gutter and a small built-in JSON5 checker,
+   since the zero-egress deployment cannot load CodeMirror from a CDN. */
+"use strict";
+
+/* ---------------- tiny JSON5 syntax checker (lint only) ----------------
+   Tolerates: // and block comments, trailing commas, single-quoted strings,
+   unquoted identifier keys, +/-/leading-dot numbers, Infinity/NaN.
+   Returns null on success or {line, col, message} on the first error. */
+function json5Check(text) {
+  let i = 0;
+  const n = text.length;
+  function err(message) {
+    const upto = text.slice(0, i);
+    const line = upto.split("\n").length;
+    const col = i - upto.lastIndexOf("\n");
+    return { line, col, message };
+  }
+  function ws() {
+    for (;;) {
+      while (i < n && /[\s]/.test(text[i])) i++;
+      if (text[i] === "/" && text[i + 1] === "/") {
+        while (i < n && text[i] !== "\n") i++;
+      } else if (text[i] === "/" && text[i + 1] === "*") {
+        i += 2;
+        while (i < n && !(text[i] === "*" && text[i + 1] === "/")) i++;
+        if (i >= n) return "unterminated block comment";
+        i += 2;
+      } else {
+        return null;
+      }
+    }
+  }
+  function string(quote) {
+    i++; // opening quote
+    while (i < n) {
+      const c = text[i];
+      if (c === "\\") { i += 2; continue; }
+      if (c === quote) { i++; return null; }
+      if (c === "\n") return "unterminated string (newline in string)";
+      i++;
+    }
+    return "unterminated string";
+  }
+  function value() {
+    const e = ws();
+    if (e) return e;
+    if (i >= n) return "unexpected end of input";
+    const c = text[i];
+    if (c === "{") return object();
+    if (c === "[") return array();
+    if (c === '"' || c === "'") {
+      const s = string(c);
+      return s ? err(s) : null;
+    }
+    const m = /^(?:[+-]?(?:Infinity|NaN|0x[0-9a-fA-F]+|(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?)|true|false|null)/
+      .exec(text.slice(i));
+    if (m) { i += m[0].length; return null; }
+    return err(`unexpected character ${JSON.stringify(c)}`);
+  }
+  function object() {
+    i++; // {
+    for (;;) {
+      let e = ws();
+      if (e) return e;
+      if (i >= n) return err("unterminated object");
+      if (text[i] === "}") { i++; return null; }
+      // key: quoted string or identifier
+      if (text[i] === '"' || text[i] === "'") {
+        const s = string(text[i]);
+        if (s) return err(s);
+      } else {
+        const m = /^[$A-Za-z_][$\w]*/.exec(text.slice(i));
+        if (!m) return err("expected object key");
+        i += m[0].length;
+      }
+      e = ws();
+      if (e) return e;
+      if (text[i] !== ":") return err("expected ':' after object key");
+      i++;
+      e = value();
+      if (e) return e;
+      e = ws();
+      if (e) return e;
+      if (text[i] === ",") { i++; continue; }
+      if (text[i] === "}") { i++; return null; }
+      return err("expected ',' or '}' in object");
+    }
+  }
+  function array() {
+    i++; // [
+    for (;;) {
+      let e = ws();
+      if (e) return e;
+      if (i >= n) return err("unterminated array");
+      if (text[i] === "]") { i++; return null; }
+      e = value();
+      if (e) return e;
+      e = ws();
+      if (e) return e;
+      if (text[i] === ",") { i++; continue; }
+      if (text[i] === "]") { i++; return null; }
+      return err("expected ',' or ']' in array");
+    }
+  }
+  let e = value();
+  if (e) return typeof e === "string" ? err(e) : e;
+  e = ws();
+  if (e) return e;
+  if (i < n) return err("trailing content after top-level value");
+  return null;
+}
+
+/* ---------------- helpers ---------------- */
+const $ = (id) => document.getElementById(id);
+
+function apiKey() { return $("api-key").value.trim(); }
+function authHeaders() {
+  const k = apiKey();
+  return k ? { Authorization: "Bearer " + k } : {};
+}
+
+function setStatus(el, text, cls) {
+  el.textContent = text;
+  el.className = "status" + (cls ? " " + cls : "");
+}
+
+/* ---------------- theme + key persistence ---------------- */
+if (localStorage.getItem("gw-theme") === "dark") {
+  document.body.classList.add("dark");
+}
+$("theme-toggle").addEventListener("click", () => {
+  document.body.classList.toggle("dark");
+  localStorage.setItem(
+    "gw-theme", document.body.classList.contains("dark") ? "dark" : "light");
+});
+$("api-key").value = localStorage.getItem("gw-api-key") || "";
+$("api-key").addEventListener("change", () => {
+  localStorage.setItem("gw-api-key", apiKey());
+});
+
+/* ---------------- tabs ---------------- */
+$("tabs").addEventListener("click", (ev) => {
+  const btn = ev.target.closest("button[data-tab]");
+  if (!btn) return;
+  document.querySelectorAll("#tabs button").forEach(
+    (b) => b.classList.toggle("active", b === btn));
+  document.querySelectorAll(".panel").forEach(
+    (p) => p.classList.toggle("active", p.id === "panel-" + btn.dataset.tab));
+});
+
+/* ---------------- editor panes ---------------- */
+const ENDPOINTS = {
+  rules: "/v1/config/models-rules",
+  providers: "/v1/config/providers",
+};
+const original = { rules: "", providers: "" };
+
+function syncGutter(which) {
+  const ta = $("editor-" + which);
+  const lines = ta.value.split("\n").length || 1;
+  const gutter = $("gutter-" + which);
+  gutter.textContent =
+    Array.from({ length: lines }, (_, k) => k + 1).join("\n");
+  gutter.scrollTop = ta.scrollTop;
+}
+
+function showErrors(which, errors) {
+  const box = $("errors-" + which);
+  if (errors && errors.length) {
+    box.textContent = errors.join("\n");
+    box.classList.add("visible");
+  } else {
+    box.textContent = "";
+    box.classList.remove("visible");
+  }
+}
+
+async function loadFile(which) {
+  const status = $("status-" + which);
+  setStatus(status, "loading…");
+  try {
+    const resp = await fetch(ENDPOINTS[which], { headers: authHeaders() });
+    if (!resp.ok) {
+      const body = await resp.text();
+      setStatus(status, `load failed (${resp.status}): ${body.slice(0, 200)}`, "err");
+      return;
+    }
+    const text = await resp.text();
+    original[which] = text;
+    $("editor-" + which).value = text;
+    syncGutter(which);
+    showErrors(which, null);
+    setStatus(status, "loaded", "ok");
+  } catch (e) {
+    setStatus(status, "load failed: " + e, "err");
+  }
+}
+
+function lint(which) {
+  const status = $("status-" + which);
+  const e = json5Check($("editor-" + which).value);
+  if (e) {
+    showErrors(which, [`line ${e.line}, col ${e.col}: ${e.message}`]);
+    setStatus(status, "syntax error", "err");
+    return false;
+  }
+  showErrors(which, null);
+  setStatus(status, "syntax OK", "ok");
+  return true;
+}
+
+async function saveFile(which) {
+  if (!lint(which)) return;
+  const status = $("status-" + which);
+  setStatus(status, "saving…");
+  try {
+    const resp = await fetch(ENDPOINTS[which], {
+      method: "POST",
+      headers: { "Content-Type": "text/plain", ...authHeaders() },
+      body: $("editor-" + which).value,
+    });
+    const body = await resp.json().catch(() => ({}));
+    if (resp.ok) {
+      original[which] = $("editor-" + which).value;
+      showErrors(which, null);
+      setStatus(status,
+        `saved & reloaded (config v${body.config_version ?? "?"})`, "ok");
+    } else if (resp.status === 400 && body.errors) {
+      showErrors(which, body.errors);
+      setStatus(status, body.detail || "validation failed", "err");
+    } else if (resp.status === 401 || resp.status === 403) {
+      setStatus(status, "auth failed — set the gateway API key (top right)", "err");
+    } else {
+      setStatus(status, `save failed (${resp.status}): ${body.detail || ""}`, "err");
+    }
+  } catch (e) {
+    setStatus(status, "save failed: " + e, "err");
+  }
+}
+
+for (const which of ["rules", "providers"]) {
+  const ta = $("editor-" + which);
+  ta.addEventListener("input", () => syncGutter(which));
+  ta.addEventListener("scroll", () => {
+    $("gutter-" + which).scrollTop = ta.scrollTop;
+  });
+  ta.addEventListener("keydown", (ev) => {   // Tab inserts two spaces
+    if (ev.key === "Tab") {
+      ev.preventDefault();
+      const s = ta.selectionStart;
+      ta.setRangeText("  ", s, ta.selectionEnd, "end");
+      syncGutter(which);
+    }
+  });
+  $("save-" + which).addEventListener("click", () => saveFile(which));
+  $("lint-" + which).addEventListener("click", () => lint(which));
+  $("revert-" + which).addEventListener("click", () => {
+    ta.value = original[which];
+    syncGutter(which);
+    showErrors(which, null);
+    setStatus($("status-" + which), "reverted", "ok");
+  });
+  loadFile(which);
+}
+
+window.addEventListener("beforeunload", (ev) => {
+  if ($("editor-rules").value !== original.rules ||
+      $("editor-providers").value !== original.providers) {
+    ev.preventDefault();
+  }
+});
+
+/* ---------------- agents integration ---------------- */
+const AGENT_ENDPOINTS = {
+  oc: { url: "/v1/models/AsOpenCodeFormat", file: "opencode.json" },
+  gh: { url: "/v1/models/AsGitHubCopilotFormat", file: "chatLanguageModels.json" },
+};
+
+async function fetchAgentConfig(kind) {
+  const include = $(kind + "-fallback").checked ? "true" : "false";
+  const { url } = AGENT_ENDPOINTS[kind];
+  const resp = await fetch(`${url}?includefallbackmodels=${include}`,
+                           { headers: authHeaders() });
+  if (!resp.ok) throw new Error(`HTTP ${resp.status}`);
+  return await resp.json();
+}
+
+function download(filename, data) {
+  const blob = new Blob([JSON.stringify(data, null, 2)],
+                        { type: "application/json" });
+  const a = document.createElement("a");
+  a.href = URL.createObjectURL(blob);
+  a.download = filename;
+  a.click();
+  URL.revokeObjectURL(a.href);
+}
+
+for (const kind of ["oc", "gh"]) {
+  $(kind + "-preview").addEventListener("click", async () => {
+    const status = $("status-agents");
+    try {
+      const data = await fetchAgentConfig(kind);
+      const pre = $("agents-preview");
+      pre.textContent = JSON.stringify(data, null, 2);
+      pre.style.display = "block";
+      setStatus(status, "", "");
+    } catch (e) {
+      setStatus(status, "fetch failed: " + e, "err");
+    }
+  });
+  $(kind + "-download").addEventListener("click", async () => {
+    const status = $("status-agents");
+    try {
+      download(AGENT_ENDPOINTS[kind].file, await fetchAgentConfig(kind));
+      setStatus(status, "downloaded " + AGENT_ENDPOINTS[kind].file, "ok");
+    } catch (e) {
+      setStatus(status, "download failed: " + e, "err");
+    }
+  });
+}
