@@ -1,0 +1,29 @@
+#!/bin/sh
+# Preflight + exec, mirroring the reference entrypoint's three hard checks
+# (GATEWAY_API_KEY, providers.json, models_fallback_rules.json) with explicit
+# messages, then signal-forwarding exec of the server.
+set -eu
+
+CONFIG_DIR="${CONFIG_DIR:-/app/config}"
+
+fail() {
+    echo "FATAL: $1" >&2
+    echo "       $2" >&2
+    exit 1
+}
+
+[ -n "${GATEWAY_API_KEY:-}" ] || fail \
+    "GATEWAY_API_KEY is not set." \
+    "Set it in the environment (compose: .env) — the gateway refuses to start unauthenticated."
+
+[ -f "$CONFIG_DIR/providers.json" ] || fail \
+    "$CONFIG_DIR/providers.json not found." \
+    "Mount your providers.json into the container (see docker-compose.yml volumes)."
+
+[ -f "$CONFIG_DIR/models_fallback_rules.json" ] || fail \
+    "$CONFIG_DIR/models_fallback_rules.json not found." \
+    "Mount your models_fallback_rules.json into the container (see docker-compose.yml volumes)."
+
+echo "Starting LLM gateway (config=$CONFIG_DIR, port=${GATEWAY_PORT:-9100})"
+# exec replaces the shell so SIGTERM/SIGINT reach the server directly.
+exec python main.py

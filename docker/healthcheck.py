@@ -1,0 +1,41 @@
+"""Container healthcheck: GET /health expecting {"status": "ok"}.
+
+Counterpart of the reference's docker/healthcheck.py (3 attempts with a
+short backoff, exit 0/1 for Docker HEALTHCHECK). stdlib-only so it runs in
+any slimmed image layer.
+"""
+import json
+import os
+import sys
+import time
+import urllib.request
+
+ATTEMPTS = 3
+TIMEOUT_S = 4.0
+BACKOFF_S = 1.0
+
+
+def main() -> int:
+    port = os.environ.get("GATEWAY_PORT", "9100")
+    url = f"http://127.0.0.1:{port}/health"
+    last_err = "unknown"
+    for attempt in range(1, ATTEMPTS + 1):
+        try:
+            with urllib.request.urlopen(url, timeout=TIMEOUT_S) as resp:
+                if resp.status == 200:
+                    body = json.loads(resp.read().decode())
+                    if body.get("status") == "ok":
+                        return 0
+                    last_err = f"unexpected body: {body!r}"
+                else:
+                    last_err = f"HTTP {resp.status}"
+        except Exception as e:  # noqa: BLE001 — any failure is "unhealthy"
+            last_err = str(e)
+        if attempt < ATTEMPTS:
+            time.sleep(BACKOFF_S)
+    print(f"unhealthy: {last_err}", file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
