@@ -36,6 +36,7 @@ class LocalEngineConfig(BaseModel):
     mesh: dict[str, int] = Field(default_factory=dict)   # e.g. {"data":1,"model":8}
     max_batch_size: int = 8
     max_seq_len: int = 4096
+    kv_layout: str = "contiguous"   # "contiguous" | "paged"
     kv_page_size: int = 128
     kv_num_pages: int = 0           # 0 → derived from max_batch_size*max_seq_len
     prefill_chunk: int = 512

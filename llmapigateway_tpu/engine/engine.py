@@ -18,8 +18,11 @@ Design (SURVEY.md §2b "Serving scheduler", §7 steps 5-6):
 * Per-slot sampling params live in device arrays; sampling is part of the
   decode program (no host round-trip per token beyond the sampled ids).
 
-The KV cache here is the dense per-slot layout (models/llama.py `KVCache`);
-ops/paged_attention.py supplies the paged-attention upgrade path.
+Two KV layouts, selected by ``kv_layout``: the dense per-slot cache
+(models/llama.py ``KVCache``) and the paged pool
+(ops/paged_attention.py ``PagedKVCache`` + engine/paged.py allocator) where
+admission reserves pages for a request's whole lifetime — page exhaustion
+is backpressure at admission, never a mid-generation failure.
 """
 from __future__ import annotations
 
@@ -110,6 +113,9 @@ class InferenceEngine:
         self.S = min(engine_cfg.max_seq_len, model_cfg.max_seq_len)
         self.prefill_chunk = engine_cfg.prefill_chunk
         self.decode_burst = max(1, engine_cfg.decode_burst)
+        if engine_cfg.kv_layout not in ("contiguous", "paged"):
+            raise ValueError(f"unknown kv_layout {engine_cfg.kv_layout!r}")
+        self.paged = engine_cfg.kv_layout == "paged"
 
         self.tokenizer = load_tokenizer(
             engine_cfg.tokenizer_path or engine_cfg.model_path or None,
@@ -121,6 +127,7 @@ class InferenceEngine:
 
         self._queue: asyncio.Queue[GenRequest] = asyncio.Queue(
             maxsize=max(2 * self.B, 16))
+        self._head: GenRequest | None = None   # FIFO head awaiting admission
         self._free_slots = list(range(self.B))
         self._running: dict[int, GenRequest] = {}
         self._prefilling: dict[int, GenRequest] = {}
@@ -153,11 +160,32 @@ class InferenceEngine:
 
     def _init_state(self) -> None:
         c = self.model_cfg
-        csh = cache_sharding(self.mesh, c.n_kv_heads, self.B)
-        shape = (c.n_layers, self.B, c.n_kv_heads, self.S, c.head_dim)
-        self.cache = llama.KVCache(
-            k=jax.device_put(jnp.zeros(shape, self.dtype), csh),
-            v=jax.device_put(jnp.zeros(shape, self.dtype), csh))
+        if self.paged:
+            from ..parallel.sharding import paged_cache_sharding
+            from ..ops.paged_attention import PagedKVCache
+            from .paged import PageAllocator
+
+            page = self.cfg.kv_page_size
+            per_slot = (self.S + page - 1) // page
+            num_pages = self.cfg.kv_num_pages or (self.B * per_slot + 1)
+            if num_pages - 1 < per_slot:
+                raise ValueError(
+                    f"kv_num_pages={num_pages} cannot hold one max-length "
+                    f"sequence ({per_slot} pages of {page})")
+            self.allocator = PageAllocator(num_pages, page, self.B, self.S)
+            psh = paged_cache_sharding(self.mesh, c.n_kv_heads)
+            shape = (c.n_layers, num_pages, c.n_kv_heads, page, c.head_dim)
+            self.cache = PagedKVCache(
+                k=jax.device_put(jnp.zeros(shape, self.dtype), psh),
+                v=jax.device_put(jnp.zeros(shape, self.dtype), psh))
+            self._d_table = None
+            self._table_dirty = True
+        else:
+            csh = cache_sharding(self.mesh, c.n_kv_heads, self.B)
+            shape = (c.n_layers, self.B, c.n_kv_heads, self.S, c.head_dim)
+            self.cache = llama.KVCache(
+                k=jax.device_put(jnp.zeros(shape, self.dtype), csh),
+                v=jax.device_put(jnp.zeros(shape, self.dtype), csh))
         # Host-authoritative per-slot state, mirrored to device each step.
         self.lengths = np.zeros((self.B,), np.int32)
         self.active = np.zeros((self.B,), bool)
@@ -175,6 +203,9 @@ class InferenceEngine:
         self._d_dirty = True
 
     def _compile(self) -> None:
+        if self.paged:
+            self._compile_paged()
+            return
         c = self.model_cfg
         family_forward = forward_fn(c)
         attention_fn = self._pick_attention()
@@ -216,28 +247,78 @@ class InferenceEngine:
             new_lengths = jnp.where(active, lengths + 1, lengths)
             return next_tokens, new_lengths, cache
 
-        @jax.jit
-        def sample_one(logits: jax.Array, temperature: jax.Array,
-                       top_p: jax.Array, top_k: jax.Array,
-                       key: jax.Array) -> jax.Array:
-            samp = SamplingParams(temperature=temperature[None],
-                                  top_p=top_p[None], top_k=top_k[None])
-            return sample(logits[None], samp, key)[0]
-
         self._prefill_fn = prefill_step
         self._decode_fn = decode_step
-        self._sample_one = sample_one
+        self._sample_one = _jit_sample_one()
 
-    def _pick_attention(self):
-        """Resolve cfg.attention: "pallas" → flash kernels, "reference" →
-        the jnp path, "auto" → flash on real TPU backends (interpret-mode
-        Pallas on CPU is correct but slower than XLA's fused jnp)."""
+    def _resolve_attention_impl(self) -> str:
+        """Validate cfg.attention and resolve "auto" (pallas on real TPU;
+        interpret-mode Pallas on CPU is correct but slower than fused jnp)."""
         impl = self.cfg.attention
         if impl not in ("auto", "pallas", "reference"):
             raise ValueError(f"unknown attention impl {impl!r}; "
                              f"expected auto | pallas | reference")
         if impl == "auto":
-            impl = "pallas" if jax.default_backend() == "tpu" else "reference"
+            return "pallas" if jax.default_backend() == "tpu" else "reference"
+        return impl
+
+    def _compile_paged(self) -> None:
+        """Compile the paged-cache step programs. The attention_fn is built
+        INSIDE each jitted step, closing over the traced page table — the
+        model forward signature stays cache-layout-agnostic."""
+        c = self.model_cfg
+        family_forward = forward_fn(c)
+        from ..ops.paged_attention import PagedKVCache, make_paged_attention_fn
+
+        impl = self._resolve_attention_impl()
+        mesh = self.mesh if self.mesh.size > 1 else None
+        logger.info("paged KV cache: %d pages × %d tokens, attention=%s",
+                    self.allocator.num_pages, self.allocator.page_size, impl)
+        S = self.S
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def prefill_step(params, cache: PagedKVCache, table: jax.Array,
+                         tokens: jax.Array, start_len: jax.Array,
+                         slot: jax.Array) -> tuple[jax.Array, PagedKVCache]:
+            """One prompt chunk for one slot. tokens [1, C]; the pool is
+            global, so unlike the dense path there is no per-slot row slice
+            — the slot's page-table row does the routing."""
+            row = jax.lax.dynamic_slice_in_dim(table, slot, 1, axis=0)
+            attn = make_paged_attention_fn(row, max_seq=S, impl=impl,
+                                           mesh=mesh)
+            logits, cache = family_forward(
+                params, c, tokens, start_len[None], cache, attention_fn=attn)
+            return logits[0], PagedKVCache(k=cache.k, v=cache.v)
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def decode_step(params, cache: PagedKVCache, table: jax.Array,
+                        tokens: jax.Array, lengths: jax.Array,
+                        active: jax.Array, samp: SamplingParams,
+                        key: jax.Array):
+            attn = make_paged_attention_fn(table, max_seq=S, impl=impl,
+                                           mesh=mesh)
+            logits, cache = family_forward(
+                params, c, tokens[:, None], lengths, cache, active=active,
+                attention_fn=attn)
+            next_tokens = sample(logits[:, 0, :], samp, key)
+            new_lengths = jnp.where(active, lengths + 1, lengths)
+            return (next_tokens, new_lengths,
+                    PagedKVCache(k=cache.k, v=cache.v))
+
+        self._prefill_fn = prefill_step
+        self._decode_fn = decode_step
+        self._sample_one = _jit_sample_one()
+
+    def _device_table(self) -> jax.Array:
+        if self._table_dirty or self._d_table is None:
+            self._d_table = jnp.asarray(self.allocator.table)
+            self._table_dirty = False
+        return self._d_table
+
+    def _pick_attention(self):
+        """Dense-cache attention_fn for the resolved impl ("reference" →
+        None: llama.forward's default dense jnp path)."""
+        impl = self._resolve_attention_impl()
         if impl == "pallas":
             if self.mesh.size > 1:
                 # Sharded cache → the kernels must run under shard_map
@@ -268,6 +349,9 @@ class InferenceEngine:
         for req in list(self._running.values()):
             req.out_queue.put_nowait(Delta(error="engine stopped"))
             self._release(req)
+        if self._head is not None:
+            self._head.out_queue.put_nowait(Delta(error="engine stopped"))
+            self._head = None
         while not self._queue.empty():
             req = self._queue.get_nowait()
             req.out_queue.put_nowait(Delta(error="engine stopped"))
@@ -332,12 +416,28 @@ class InferenceEngine:
         event-loop thread (asyncio.Queue is not thread-safe); worker-thread
         calls only touch device programs and host numpy state."""
         # 1. Admit into free slots (dropping requests whose client is gone).
-        while self._free_slots and not self._queue.empty():
-            req = self._queue.get_nowait()
+        #    Paged layout: the FIFO head also needs its full page reservation
+        #    (engine/paged.py policy) — if pages are short it waits at the
+        #    head (no starvation: held pages always return via releases).
+        while self._free_slots:
+            if self._head is None:
+                if self._queue.empty():
+                    break
+                self._head = self._queue.get_nowait()
+            req = self._head
             if req.cancelled:
                 req.finish_reason = "cancelled"
+                self._head = None
                 continue
+            if self.paged:
+                total = min(len(req.prompt_ids) + req.max_tokens, self.S)
+                if not self.allocator.can_admit(total):
+                    break
+            self._head = None
             req.slot = self._free_slots.pop()
+            if self.paged:
+                self.allocator.allocate(req.slot, total)
+                self._table_dirty = True
             req.prefill_pos = 0
             self._running[req.slot] = req
             self._prefilling[req.slot] = req
@@ -393,12 +493,18 @@ class InferenceEngine:
         # Clamp the bucket so pos+bucket never exceeds the cache extent S:
         # XLA clamps dynamic_update_slice start indices, so an overrunning
         # padded chunk would silently shift and corrupt earlier KV entries.
+        # (Paged layout: out-of-range pad positions land on the trash page.)
         bucket = min(_bucket(len(chunk), self.prefill_chunk), self.S - pos)
         padded = np.zeros((1, bucket), np.int32)
         padded[:, :len(chunk)] = chunk
-        logits, self.cache = self._prefill_fn(
-            self.params, self.cache, jnp.asarray(padded),
-            jnp.int32(pos), jnp.int32(slot))
+        if self.paged:
+            logits, self.cache = self._prefill_fn(
+                self.params, self.cache, self._device_table(),
+                jnp.asarray(padded), jnp.int32(pos), jnp.int32(slot))
+        else:
+            logits, self.cache = self._prefill_fn(
+                self.params, self.cache, jnp.asarray(padded),
+                jnp.int32(pos), jnp.int32(slot))
         req.prefill_pos = pos + len(chunk)
         if req.prefill_pos < len(ids):
             return False
@@ -439,9 +545,15 @@ class InferenceEngine:
         pending: list[jax.Array] = []
         for _ in range(n_steps):
             self._rng, key = jax.random.split(self._rng)
-            self._d_tokens, self._d_lengths, self.cache = self._decode_fn(
-                self.params, self.cache, self._d_tokens, self._d_lengths,
-                self._d_active, self._d_samp, key)
+            if self.paged:
+                self._d_tokens, self._d_lengths, self.cache = self._decode_fn(
+                    self.params, self.cache, self._device_table(),
+                    self._d_tokens, self._d_lengths, self._d_active,
+                    self._d_samp, key)
+            else:
+                self._d_tokens, self._d_lengths, self.cache = self._decode_fn(
+                    self.params, self.cache, self._d_tokens, self._d_lengths,
+                    self._d_active, self._d_samp, key)
             try:
                 self._d_tokens.copy_to_host_async()
             except Exception:       # backend without async copies
@@ -526,16 +638,38 @@ class InferenceEngine:
             self.lengths[req.slot] = 0
             self._free_slots.append(req.slot)
             self._d_dirty = True
+            if self.paged:
+                self.allocator.release(req.slot)
+                self._table_dirty = True
 
     # -- stats ----------------------------------------------------------------
     def stats(self) -> dict[str, Any]:
-        return {
+        out = {
             "running": len(self._running),
-            "queued": self._queue.qsize(),
+            "queued": self._queue.qsize() + (1 if self._head else 0),
             "free_slots": len(self._free_slots),
             "batch_size": self.B,
             "max_seq_len": self.S,
+            "kv_layout": self.cfg.kv_layout,
         }
+        if self.paged:
+            out["free_pages"] = self.allocator.free_pages
+            out["total_pages"] = self.allocator.num_pages - 1
+            out["page_size"] = self.allocator.page_size
+        return out
+
+
+def _jit_sample_one():
+    """Single-sequence sampler (first token off a prefill's logits) — shared
+    by the dense and paged compile paths."""
+    @jax.jit
+    def sample_one(logits: jax.Array, temperature: jax.Array,
+                   top_p: jax.Array, top_k: jax.Array,
+                   key: jax.Array) -> jax.Array:
+        samp = SamplingParams(temperature=temperature[None],
+                              top_p=top_p[None], top_k=top_k[None])
+        return sample(logits[None], samp, key)[0]
+    return sample_one
 
 
 def _bucket(n: int, cap: int) -> int:

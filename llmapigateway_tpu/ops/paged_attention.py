@@ -1,0 +1,412 @@
+"""Paged KV cache attention as Pallas TPU kernels (ragged paged attention).
+
+The dense per-slot cache (models/llama.py ``KVCache``) reserves ``S_max``
+tokens of HBM for every slot; the paged layout allocates fixed-size pages
+from a global pool only as sequences grow, so HBM holds the *actual* token
+count and the same memory serves more concurrent slots (cf. PAPERS.md
+"Ragged Paged Attention" — re-derived here, not copied). No reference
+counterpart: the reference proxies HTTP and has no KV cache at all
+(SURVEY.md §2b "Serving scheduler" row).
+
+Layout:
+* ``k_pages``/``v_pages``: ``[P, KV, page, Dh]`` — global page pool,
+  head-major within a page. **Physical page 0 is the trash page**: scatter
+  targets for inactive slots and out-of-range positions are redirected
+  there, so masked writes need no branching. The allocator
+  (engine/paged.py) never hands page 0 out.
+* ``page_table``: ``[B, NP]`` int32 — slot's logical page j → physical
+  page. Unallocated entries are 0 (trash) and are never read: reads are
+  bounded by ``n_valid``.
+
+Kernel structure mirrors ops/flash_attention.py (online-softmax fp32
+scratch, ``pl.when`` compute skip) with one addition: the K/V BlockSpec
+index maps translate logical → physical through the scalar-prefetched page
+table, *and* clamp to the last live logical page so dead iterations repeat
+a block index and their HBM→VMEM DMA is elided. That makes decode cost
+proportional to live tokens, not ``S_max`` — the ragged property.
+
+The adapter :func:`make_paged_attention_fn` is built INSIDE the engine's
+jitted step (closing over the traced page table), so ``llama.forward``
+needs no signature change: a ``PagedKVCache`` pytree scans over layers
+exactly like the dense cache.
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..models.config import ModelConfig
+
+NEG_INF = -1e30
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+class PagedKVCache(NamedTuple):
+    """k, v: [L, P, KV, page, Dh] — global page pool per layer. Scans over
+    the leading layer dim in llama.forward exactly like the dense KVCache."""
+    k: jax.Array
+    v: jax.Array
+
+    @classmethod
+    def create(cls, config: ModelConfig, num_pages: int, page_size: int,
+               dtype=jnp.bfloat16) -> "PagedKVCache":
+        shape = (config.n_layers, num_pages, config.n_kv_heads, page_size,
+                 config.head_dim)
+        return cls(k=jnp.zeros(shape, dtype=dtype),
+                   v=jnp.zeros(shape, dtype=dtype))
+
+    @property
+    def page_size(self) -> int:
+        return self.k.shape[3]
+
+
+def paged_insert_kv(layer_k: jax.Array, layer_v: jax.Array,
+                    k_new: jax.Array, v_new: jax.Array,
+                    page_table: jax.Array, lengths: jax.Array,
+                    active: jax.Array | None
+                    ) -> tuple[jax.Array, jax.Array]:
+    """Scatter new tokens into the page pool at logical positions
+    ``[lengths, lengths+T)`` per slot.
+
+    layer_k/v: [P, KV, page, Dh]; k_new/v_new: [B, T, KV, Dh];
+    page_table: [B, NP]; lengths: [B]. Inactive slots and positions past
+    the table's reach land on trash page 0 (one scatter, no branches).
+    """
+    P, KV, page, Dh = layer_k.shape
+    B, T = k_new.shape[:2]
+    NP = page_table.shape[1]
+
+    pos = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B,T]
+    logical = jnp.clip(pos // page, 0, NP - 1)
+    phys = jnp.take_along_axis(page_table, logical, axis=1)           # [B,T]
+    ok = (pos // page) < NP
+    if active is not None:
+        ok = ok & active[:, None]
+    phys = jnp.where(ok, phys, 0)            # trash page for masked writes
+    off = pos % page
+
+    flat_page = phys.reshape(-1)                                      # [B*T]
+    flat_off = off.reshape(-1)
+    flat_k = k_new.reshape(B * T, KV, Dh).astype(layer_k.dtype)
+    flat_v = v_new.reshape(B * T, KV, Dh).astype(layer_v.dtype)
+    # [P, KV, page, Dh] scattered at (page, :, offset, :) per new token.
+    layer_k = layer_k.at[flat_page, :, flat_off].set(flat_k)
+    layer_v = layer_v.at[flat_page, :, flat_off].set(flat_v)
+    return layer_k, layer_v
+
+
+# ---------------------------------------------------------------------------
+# Decode kernel: q [B, KV, G, Dh] vs pages [P, KV, page, Dh]
+# ---------------------------------------------------------------------------
+
+def _paged_decode_kernel(pt_ref, nvalid_ref, q_ref, k_ref, v_ref, o_ref,
+                         m_ref, l_ref, acc_ref, *, page: int):
+    b = pl.program_id(0)
+    j = pl.program_id(2)
+    n_pb = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    n_valid = nvalid_ref[b]
+
+    @pl.when(j * page < n_valid)
+    def _block():
+        q = q_ref[0, 0].astype(jnp.float32)            # [G, Dh]
+        k = k_ref[0, 0].astype(jnp.float32)            # [page, Dh]
+        v = v_ref[0, 0].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [G, page]
+        scores *= q.shape[-1] ** -0.5
+
+        pos = j * page + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
+        scores = jnp.where(pos < n_valid, scores, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new)
+        l_ref[:, :1] = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:, :1] = m_new
+
+    @pl.when(j == n_pb - 1)
+    def _out():
+        l = l_ref[:, :1]
+        o_ref[0, 0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
+                       ).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
+                           v_pages: jax.Array, page_table: jax.Array,
+                           n_valid: jax.Array, *,
+                           interpret: bool | None = None) -> jax.Array:
+    """Ragged single-token attention over the page pool.
+
+    q: [B, H, Dh] (RoPE applied); k_pages/v_pages: [P, KV, page, Dh];
+    page_table: [B, NP]; n_valid: [B] int32 (≥1). Returns [B, H*Dh].
+    """
+    B, H, Dh = q.shape
+    KV, page = k_pages.shape[1], k_pages.shape[2]
+    NP = page_table.shape[1]
+    G = H // KV
+    qg = q.reshape(B, KV, G, Dh)
+    grid = (B, KV, NP)
+
+    def kv_index(b, h, j, pt, nv):
+        last = jnp.maximum((nv[b] + page - 1) // page - 1, 0)
+        return pt[b, jnp.minimum(j, last)], h, 0, 0
+
+    out = pl.pallas_call(
+        functools.partial(_paged_decode_kernel, page=page),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, G, Dh),
+                             lambda b, h, j, pt, nv: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, page, Dh), kv_index),
+                pl.BlockSpec((1, 1, page, Dh), kv_index),
+            ],
+            out_specs=pl.BlockSpec((1, 1, G, Dh),
+                                   lambda b, h, j, pt, nv: (b, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((G, 128), jnp.float32),
+                pltpu.VMEM((G, 128), jnp.float32),
+                pltpu.VMEM((G, Dh), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, KV, G, Dh), q.dtype),
+        interpret=_interpret_default() if interpret is None else interpret,
+    )(page_table.astype(jnp.int32), n_valid.astype(jnp.int32),
+      qg, k_pages, v_pages)
+    return out.reshape(B, H * Dh)
+
+
+# ---------------------------------------------------------------------------
+# Prefill kernel: q [B, T, H, Dh] vs pages, causal from per-slot start
+# ---------------------------------------------------------------------------
+
+def _paged_prefill_kernel(pt_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
+                          m_ref, l_ref, acc_ref, *, block_t: int, page: int):
+    b = pl.program_id(0)
+    t = pl.program_id(2)
+    j = pl.program_id(3)
+    n_pb = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    start = start_ref[b]
+    last_q_pos = start + t * block_t + (block_t - 1)
+
+    @pl.when(j * page <= last_q_pos)
+    def _block():
+        q = q_ref[0, 0].astype(jnp.float32)            # [TB, Dh]
+        k = k_ref[0, 0].astype(jnp.float32)            # [page, Dh]
+        v = v_ref[0, 0].astype(jnp.float32)
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [TB, page]
+        scores *= q.shape[-1] ** -0.5
+
+        q_pos = start + t * block_t + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 0)
+        s_pos = j * page + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1)
+        scores = jnp.where(s_pos <= q_pos, scores, NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new)
+        l_ref[:, :1] = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_ref[:, :1] = m_new
+
+    @pl.when(j == n_pb - 1)
+    def _out():
+        l = l_ref[:, :1]
+        o_ref[0, 0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
+                       ).astype(o_ref.dtype)
+
+
+def paged_prefill_attention(q: jax.Array, k_pages: jax.Array,
+                            v_pages: jax.Array, page_table: jax.Array,
+                            start: jax.Array, *, block_t: int = 128,
+                            interpret: bool | None = None) -> jax.Array:
+    """Causal chunk attention over the page pool (keys already inserted).
+
+    q: [B, T, H, Dh] at absolute positions ``start + t``;
+    k_pages/v_pages: [P, KV, page, Dh]; page_table: [B, NP]; start: [B].
+    Returns [B, T, H*Dh].
+    """
+    B, T, H, Dh = q.shape
+    KV, page = k_pages.shape[1], k_pages.shape[2]
+    NP = page_table.shape[1]
+    G = H // KV
+    block_t = min(block_t, T)
+    if T % block_t:
+        raise ValueError(f"T={T} not a multiple of block_t={block_t}")
+    qh = q.transpose(0, 2, 1, 3)
+    grid = (B, H, T // block_t, NP)
+
+    def kv_index(b, h, t, j, pt, st):
+        last_q_pos = st[b] + t * block_t + (block_t - 1)
+        return pt[b, jnp.minimum(j, last_q_pos // page)], h // G, 0, 0
+
+    out = pl.pallas_call(
+        functools.partial(_paged_prefill_kernel, block_t=block_t, page=page),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, block_t, Dh),
+                             lambda b, h, t, j, pt, st: (b, h, t, 0)),
+                pl.BlockSpec((1, 1, page, Dh), kv_index),
+                pl.BlockSpec((1, 1, page, Dh), kv_index),
+            ],
+            out_specs=pl.BlockSpec((1, 1, block_t, Dh),
+                                   lambda b, h, t, j, pt, st: (b, h, t, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((block_t, 128), jnp.float32),
+                pltpu.VMEM((block_t, 128), jnp.float32),
+                pltpu.VMEM((block_t, Dh), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, T, Dh), q.dtype),
+        interpret=_interpret_default() if interpret is None else interpret,
+    )(page_table.astype(jnp.int32), start.astype(jnp.int32),
+      qh, k_pages, v_pages)
+    return out.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
+
+
+# ---------------------------------------------------------------------------
+# Reference jnp path (CPU tests / non-TPU backends) + attention_fn adapter
+# ---------------------------------------------------------------------------
+
+def gather_pages(layer_pages: jax.Array, page_table: jax.Array,
+                 max_seq: int) -> jax.Array:
+    """Materialize [B, KV, S, Dh] from the pool — reference path only."""
+    P, KV, page, Dh = layer_pages.shape
+    NP = page_table.shape[1]
+    n_pages = min(NP, (max_seq + page - 1) // page)
+    picked = layer_pages[page_table[:, :n_pages]]     # [B, n, KV, page, Dh]
+    seq = picked.transpose(0, 2, 1, 3, 4).reshape(
+        page_table.shape[0], KV, n_pages * page, Dh)
+    return seq[:, :, :max_seq]
+
+
+def _paged_reference_core(q, dense_k, dense_v, lengths, active, T):
+    """Dense attention over a gathered view WITHOUT re-inserting."""
+    B, H = q.shape[0], q.shape[2]
+    KV, S = dense_k.shape[1], dense_k.shape[2]
+    Dh = q.shape[3]
+    group = H // KV
+    k_all = jnp.repeat(dense_k, group, axis=1)
+    v_all = jnp.repeat(dense_v, group, axis=1)
+    qf = q.astype(jnp.float32)
+    scores = jnp.einsum("bthd,bhsd->bhts", qf, k_all.astype(jnp.float32))
+    scores = scores / jnp.sqrt(jnp.asarray(Dh, jnp.float32))
+    q_pos = lengths[:, None] + jnp.arange(T)[None, :]
+    s_idx = jnp.arange(S)[None, None, :]
+    visible = s_idx <= q_pos[:, :, None]
+    if active is not None:
+        visible = visible & active[:, None, None]
+    scores = jnp.where(visible[:, None, :, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhts,bhsd->bthd", probs, v_all.astype(jnp.float32))
+    return out.reshape(B, T, H * Dh).astype(q.dtype)
+
+
+def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
+                            impl: str = "pallas",
+                            block_t: int | None = None,
+                            interpret: bool | None = None,
+                            mesh=None):
+    """Build an ``attention_fn`` (llama.forward contract) over a paged cache.
+
+    Constructed INSIDE the engine's jitted step function, closing over the
+    traced ``page_table`` — so the model forward signature is unchanged and
+    ``layer_k``/``layer_v`` are the per-layer page pools from the scanned
+    ``PagedKVCache``. ``impl``: "pallas" (kernels) or "reference" (gather +
+    dense jnp — exact but materializes [B, S]; CPU tests).
+
+    With a multi-device ``mesh`` the kernels run under ``shard_map`` manual
+    over the ``model`` axis — pages are sharded on their KV-head dim, the
+    page table is replicated (it indexes the pool's unsharded page dim), and
+    the insert scatter stays in XLA/GSPMD. The pool has no batch dim, so
+    there is nothing to go manual over on ``data``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    msize = mesh.shape.get("model", 1) if mesh is not None else 1
+
+    def attention_fn(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
+        B, T, H, Dh = q.shape
+        KV = layer_k.shape[1]
+        layer_k, layer_v = paged_insert_kv(layer_k, layer_v, k_new, v_new,
+                                           page_table, lengths, active)
+        if impl == "reference":
+            dense_k = gather_pages(layer_k, page_table, max_seq)
+            dense_v = gather_pages(layer_v, page_table, max_seq)
+            out = _paged_reference_core(q, dense_k, dense_v, lengths,
+                                        active, T)
+            return out, layer_k, layer_v
+        shard = msize > 1 and KV % msize == 0 and H % msize == 0
+        pool = P(None, "model", None, None)
+        if T == 1:
+            n_valid = lengths + 1
+            if active is not None:
+                n_valid = jnp.where(active, n_valid, 1)
+            if shard:
+                f = jax.shard_map(
+                    lambda q_, k_, v_, pt_, nv_: paged_decode_attention(
+                        q_, k_, v_, pt_, nv_, interpret=interpret),
+                    mesh=mesh,
+                    in_specs=(P(None, "model", None), pool, pool,
+                              P(None, None), P(None)),
+                    out_specs=P(None, "model"),
+                    axis_names={"model"}, check_vma=False)
+                out = f(q[:, 0], layer_k, layer_v, page_table, n_valid)
+            else:
+                out = paged_decode_attention(
+                    q[:, 0], layer_k, layer_v, page_table, n_valid,
+                    interpret=interpret)
+            return out[:, None, :], layer_k, layer_v
+        bt = block_t if block_t is not None else min(T & (-T), 128)
+        if shard:
+            f = jax.shard_map(
+                lambda q_, k_, v_, pt_, st_: paged_prefill_attention(
+                    q_, k_, v_, pt_, st_, block_t=bt, interpret=interpret),
+                mesh=mesh,
+                in_specs=(P(None, None, "model", None), pool, pool,
+                          P(None, None), P(None)),
+                out_specs=P(None, None, "model"),
+                axis_names={"model"}, check_vma=False)
+            out = f(q, layer_k, layer_v, page_table, lengths)
+        else:
+            out = paged_prefill_attention(
+                q, layer_k, layer_v, page_table, lengths,
+                block_t=bt, interpret=interpret)
+        return out, layer_k, layer_v
+    return attention_fn

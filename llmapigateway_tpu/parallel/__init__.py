@@ -1,5 +1,13 @@
 from .mesh import build_mesh, MeshSpec
-from .sharding import param_shardings, cache_sharding, batch_sharding
+from .ring_attention import ring_attention
+from .sharding import (
+    batch_sharding,
+    cache_sharding,
+    paged_cache_sharding,
+    param_shardings,
+)
+from .ulysses import ulysses_attention
 
 __all__ = ["build_mesh", "MeshSpec", "param_shardings", "cache_sharding",
-           "batch_sharding"]
+           "paged_cache_sharding", "batch_sharding", "ring_attention",
+           "ulysses_attention"]

@@ -100,6 +100,13 @@ def cache_sharding(mesh: Mesh, n_kv_heads: int, batch: int) -> NamedSharding:
         _axis(mesh, "model", n_kv_heads), None, None))
 
 
+def paged_cache_sharding(mesh: Mesh, n_kv_heads: int) -> NamedSharding:
+    """Paged pool [L, P, KV, page, Dh]: KV heads on model; the page dim is a
+    global pool indexed by the (replicated) page table, so it never shards."""
+    return NamedSharding(mesh, P(
+        None, None, _axis(mesh, "model", n_kv_heads), None, None))
+
+
 def batch_sharding(mesh: Mesh, batch: int) -> NamedSharding:
     """[B, ...] host batch arrays: batch dim on data axis."""
     return NamedSharding(mesh, P(_axis(mesh, "data", batch)))

@@ -1,0 +1,170 @@
+"""Paged KV cache: scatter insert, Pallas ragged paged kernels (decode +
+prefill) and the jnp reference path, all cross-checked against the dense
+cache attention; allocator property tests."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llmapigateway_tpu.engine.paged import PageAllocator
+from llmapigateway_tpu.models.llama import dense_cache_attention, insert_kv
+from llmapigateway_tpu.ops.paged_attention import (
+    gather_pages,
+    make_paged_attention_fn,
+    paged_insert_kv,
+)
+
+
+def _setup(B, S, T, H, KV, Dh, page, seed=0, scramble=True):
+    """Random q/k/v + a page table whose physical pages are scrambled, plus
+    pre-filled page content matching a dense cache for cross-checking."""
+    NP = S // page
+    P = B * NP + 1 + 3            # pool with spare pages; page 0 = trash
+    rng = np.random.default_rng(seed)
+    phys = np.arange(1, B * NP + 1)
+    if scramble:
+        rng.shuffle(phys)
+    table = phys.reshape(B, NP).astype(np.int32)
+
+    keys = jax.random.split(jax.random.PRNGKey(seed), 5)
+    q = jax.random.normal(keys[0], (B, T, H, Dh), jnp.float32)
+    k_new = jax.random.normal(keys[1], (B, T, KV, Dh), jnp.float32)
+    v_new = jax.random.normal(keys[2], (B, T, KV, Dh), jnp.float32)
+    dense_k = jax.random.normal(keys[3], (B, KV, S, Dh), jnp.float32)
+    dense_v = jax.random.normal(keys[4], (B, KV, S, Dh), jnp.float32)
+
+    # Lay the dense content out into the paged pool via the table.
+    pk = np.zeros((P, KV, page, Dh), np.float32)
+    pv = np.zeros((P, KV, page, Dh), np.float32)
+    dk, dv = np.asarray(dense_k), np.asarray(dense_v)
+    for b in range(B):
+        for j in range(NP):
+            pk[table[b, j]] = dk[b, :, j * page:(j + 1) * page]
+            pv[table[b, j]] = dv[b, :, j * page:(j + 1) * page]
+    return (q, k_new, v_new, dense_k, dense_v,
+            jnp.asarray(pk), jnp.asarray(pv), jnp.asarray(table))
+
+
+def test_gather_pages_roundtrip():
+    B, S, H, KV, Dh, page = 2, 64, 4, 2, 16, 16
+    _, _, _, dense_k, _, pk, _, table = _setup(B, S, 1, H, KV, Dh, page)
+    got = gather_pages(pk, table, S)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(dense_k))
+
+
+def test_paged_insert_matches_dense_insert():
+    B, S, T, H, KV, Dh, page = 3, 64, 8, 4, 2, 16, 16
+    (q, k_new, v_new, dense_k, dense_v, pk, pv, table) = _setup(
+        B, S, T, H, KV, Dh, page, seed=1)
+    lengths = jnp.asarray([0, 13, 40], jnp.int32)
+    active = jnp.asarray([True, False, True])
+
+    ref_k, ref_v = insert_kv(dense_k, dense_v, k_new, v_new, lengths, active)
+    got_pk, got_pv = paged_insert_kv(pk, pv, k_new, v_new, table, lengths,
+                                     active)
+    np.testing.assert_allclose(np.asarray(gather_pages(got_pk, table, S)),
+                               np.asarray(ref_k))
+    np.testing.assert_allclose(np.asarray(gather_pages(got_pv, table, S)),
+                               np.asarray(ref_v))
+
+
+@pytest.mark.parametrize("impl", ["reference", "pallas"])
+@pytest.mark.parametrize("B,S,H,KV,Dh,page", [
+    (3, 64, 4, 2, 16, 16),     # GQA, several pages
+    (2, 128, 8, 8, 32, 128),   # MHA, one page per slot
+    (1, 256, 4, 1, 64, 32),    # MQA-ish
+])
+def test_paged_decode_matches_dense(impl, B, S, H, KV, Dh, page):
+    (q, k_new, v_new, dense_k, dense_v, pk, pv, table) = _setup(
+        B, S, 1, H, KV, Dh, page, seed=2)
+    lengths = jnp.asarray(
+        np.random.default_rng(0).integers(0, S - 1, B), jnp.int32)
+    active = jnp.ones((B,), bool)
+
+    ref, _, _ = dense_cache_attention(q, k_new, v_new, dense_k, dense_v,
+                                      lengths, active)
+    attn = make_paged_attention_fn(table, max_seq=S, impl=impl,
+                                   interpret=True)
+    got, _, _ = attn(q, k_new, v_new, pk, pv, lengths, active)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("impl", ["reference", "pallas"])
+@pytest.mark.parametrize("B,S,T,H,KV,Dh,page,start_max", [
+    (2, 128, 16, 4, 2, 16, 32, 100),
+    (1, 64, 64, 2, 2, 32, 16, 0),
+    (2, 256, 32, 8, 4, 64, 128, 200),
+])
+def test_paged_prefill_matches_dense(impl, B, S, T, H, KV, Dh, page,
+                                     start_max):
+    (q, k_new, v_new, dense_k, dense_v, pk, pv, table) = _setup(
+        B, S, T, H, KV, Dh, page, seed=3)
+    rng = np.random.default_rng(1)
+    start = jnp.asarray(rng.integers(0, start_max + 1, B), jnp.int32)
+
+    ref, _, _ = dense_cache_attention(q, k_new, v_new, dense_k, dense_v,
+                                      start)
+    attn = make_paged_attention_fn(table, max_seq=S, impl=impl,
+                                   interpret=True, block_t=min(T, 16))
+    got, _, _ = attn(q, k_new, v_new, pk, pv, start)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_inactive_writes_land_on_trash_page():
+    B, S, T, H, KV, Dh, page = 2, 64, 4, 4, 2, 16, 16
+    (q, k_new, v_new, _, _, pk, pv, table) = _setup(
+        B, S, T, H, KV, Dh, page, seed=4)
+    lengths = jnp.asarray([8, 8], jnp.int32)
+    active = jnp.asarray([False, False])
+    got_pk, _ = paged_insert_kv(pk, pv, k_new, v_new, table, lengths, active)
+    # All non-trash pages untouched; trash page absorbed the writes.
+    np.testing.assert_allclose(np.asarray(got_pk)[1:], np.asarray(pk)[1:])
+
+
+def test_allocator_invariants_under_random_workload():
+    rng = np.random.default_rng(7)
+    alloc = PageAllocator(num_pages=33, page_size=16, batch=8, max_seq=256)
+    held = {}
+    for step in range(500):
+        alloc.check_invariants()
+        if held and (rng.random() < 0.4 or len(held) == 8):
+            slot = rng.choice(list(held))
+            alloc.release(slot)
+            del held[slot]
+        else:
+            free = [s for s in range(8) if s not in held]
+            slot = int(rng.choice(free))
+            tokens = int(rng.integers(1, 300))
+            free_before = alloc.free_pages
+            ok = alloc.allocate(slot, tokens)
+            assert ok == (alloc.pages_needed(tokens) <= free_before)
+            if ok:
+                held[slot] = True
+            else:
+                # allocation must be all-or-nothing
+                assert alloc.table[slot].sum() == 0
+    alloc.check_invariants()
+
+
+def test_allocator_reservation_accounting():
+    alloc = PageAllocator(num_pages=9, page_size=16, batch=4, max_seq=64)
+    # 8 allocatable pages; slot needs ceil(min(tokens, 64)/16)
+    assert alloc.pages_needed(1) == 1
+    assert alloc.pages_needed(17) == 2
+    assert alloc.pages_needed(10_000) == 4   # capped by max_seq
+    assert alloc.allocate(0, 64)
+    assert alloc.allocate(1, 64)
+    assert alloc.free_pages == 0
+    assert not alloc.can_admit(1)
+    assert not alloc.allocate(2, 1)
+    alloc.release(0)
+    assert alloc.free_pages == 4
+    assert alloc.allocate(2, 33)             # 3 pages
+    assert alloc.free_pages == 1
+    alloc.check_invariants()
+    # double-release is a no-op; re-allocating a held slot raises
+    alloc.release(0)
+    with pytest.raises(ValueError):
+        alloc.allocate(2, 1)
