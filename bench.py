@@ -28,6 +28,10 @@ def main() -> None:
     ap.add_argument("--warmup", type=int, default=20)
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--prompt-len", type=int, default=128)
+    ap.add_argument("--burst", type=int, default=32,
+                    help="chained decode steps per host sync")
+    ap.add_argument("--kv", default="contiguous",
+                    choices=["contiguous", "paged"])
     args = ap.parse_args()
 
     import jax
@@ -40,7 +44,8 @@ def main() -> None:
 
     eng_cfg = LocalEngineConfig(
         preset=args.preset, dtype="bfloat16", max_batch_size=args.batch,
-        max_seq_len=args.seq, prefill_chunk=min(512, args.prompt_len))
+        max_seq_len=args.seq, prefill_chunk=min(512, args.prompt_len),
+        decode_burst=args.burst, kv_layout=args.kv)
     t0 = time.monotonic()
     engine = InferenceEngine(eng_cfg)
     init_s = time.monotonic() - t0
@@ -53,14 +58,23 @@ def main() -> None:
     prompt = rng.integers(0, engine.model_cfg.vocab_size,
                           size=args.prompt_len).astype(np.int32)
     for slot in range(B):
+        if engine.paged:
+            engine.allocator.allocate(slot, min(
+                len(prompt) + args.steps + args.warmup + 1, S))
+            engine._table_dirty = True
         pos = 0
         while pos < len(prompt):
             chunk = prompt[pos:pos + engine.prefill_chunk]
             padded = np.zeros((1, engine.prefill_chunk), np.int32)
             padded[0, :len(chunk)] = chunk
-            logits, engine.cache = engine._prefill_fn(
-                engine.params, engine.cache, jnp.asarray(padded),
-                jnp.int32(pos), jnp.int32(slot))
+            if engine.paged:
+                logits, engine.cache = engine._prefill_fn(
+                    engine.params, engine.cache, engine._device_table(),
+                    jnp.asarray(padded), jnp.int32(pos), jnp.int32(slot))
+            else:
+                logits, engine.cache = engine._prefill_fn(
+                    engine.params, engine.cache, jnp.asarray(padded),
+                    jnp.int32(pos), jnp.int32(slot))
             pos += len(chunk)
         engine.lengths[slot] = len(prompt)
         engine.active[slot] = True
@@ -69,32 +83,28 @@ def main() -> None:
     prefill_s = time.monotonic() - t0
     prefill_tok_s = B * args.prompt_len / prefill_s
 
-    samp = SamplingParams(
-        temperature=jnp.asarray(engine.samp_temperature),
-        top_p=jnp.asarray(engine.samp_top_p),
-        top_k=jnp.asarray(engine.samp_top_k))
-    lengths = jnp.asarray(engine.lengths)
-    active = jnp.asarray(engine.active)
-    tokens = jnp.asarray(engine.last_token)
-    key = jax.random.PRNGKey(0)
-
-    def step(tokens, lengths, key):
-        key, sub = jax.random.split(key)
-        next_tokens, engine.cache = engine._decode_fn(
-            engine.params, engine.cache, tokens, lengths, active, samp, sub)
-        return next_tokens, lengths + 1, key
-
-    # NOTE: block_until_ready does not reliably sync through the axon TPU
-    # tunnel; fetching the sampled token values (np.asarray) is the honest
-    # sync — and matches the serving loop, which reads every step's tokens.
-    for _ in range(args.warmup):
-        tokens, lengths, key = step(tokens, lengths, key)
-    np.asarray(tokens)
+    # Time decode through the engine's real hot loop (_decode_burst): chained
+    # device-side token feedback, async host fetch of every step's sampled
+    # tokens — fetching the values IS the honest sync (block_until_ready does
+    # not reliably sync through the axon TPU tunnel), and it matches serving,
+    # which reads every token it streams out.
+    engine._d_dirty = True
+    burst = max(1, engine.decode_burst)
+    # Warmup must compile every program the timed loop will use: the fused
+    # scan (full bursts) AND the per-step fallback (a non-multiple tail).
+    engine._decode_burst(burst)
+    tail = args.steps % burst
+    if tail:
+        engine._decode_burst(tail)
+    for _ in range(max(0, args.warmup - burst - tail) // burst):
+        engine._decode_burst(burst)
 
     t0 = time.monotonic()
-    for _ in range(args.steps):
-        tokens, lengths, key = step(tokens, lengths, key)
-        np.asarray(tokens)
+    done = 0
+    while done < args.steps:
+        n = min(burst, args.steps - done)
+        engine._decode_burst(n)
+        done += n
     decode_s = time.monotonic() - t0
 
     tok_s = B * args.steps / decode_s

@@ -247,8 +247,31 @@ class InferenceEngine:
             new_lengths = jnp.where(active, lengths + 1, lengths)
             return next_tokens, new_lengths, cache
 
+        n_burst = self.decode_burst
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def decode_scan(params, cache: llama.KVCache, tokens: jax.Array,
+                        lengths: jax.Array, active: jax.Array,
+                        samp: SamplingParams, key: jax.Array):
+            """A full decode burst as ONE compiled program (lax.scan over
+            `decode_burst` steps): one dispatch + one host fetch per burst
+            instead of per step — through a remote-device tunnel, dispatch
+            latency is the decode bottleneck, not FLOPs."""
+            def body(carry, _):
+                cache, tokens, lengths, key = carry
+                key, sub = jax.random.split(key)
+                logits, cache = model_forward(
+                    params, c, tokens[:, None], lengths, cache, active=active)
+                nt = sample(logits[:, 0, :], samp, sub)
+                nl = jnp.where(active, lengths + 1, lengths)
+                return (cache, nt, nl, key), nt
+            (cache, tokens, lengths, key), toks = jax.lax.scan(
+                body, (cache, tokens, lengths, key), None, length=n_burst)
+            return toks, tokens, lengths, cache
+
         self._prefill_fn = prefill_step
         self._decode_fn = decode_step
+        self._decode_scan_fn = decode_scan if n_burst > 1 else None
         self._sample_one = _jit_sample_one()
 
     def _resolve_attention_impl(self) -> str:
@@ -305,8 +328,35 @@ class InferenceEngine:
             return (next_tokens, new_lengths,
                     PagedKVCache(k=cache.k, v=cache.v))
 
+        n_burst = self.decode_burst
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def decode_scan(params, cache: PagedKVCache, table: jax.Array,
+                        tokens: jax.Array, lengths: jax.Array,
+                        active: jax.Array, samp: SamplingParams,
+                        key: jax.Array):
+            """Full decode burst as one program (see dense twin): the page
+            table is loop-invariant — pages are reserved for a request's
+            whole lifetime at admission, so no page can change mid-burst."""
+            attn = make_paged_attention_fn(table, max_seq=S, impl=impl,
+                                           mesh=mesh)
+
+            def body(carry, _):
+                cache, tokens, lengths, key = carry
+                key, sub = jax.random.split(key)
+                logits, cache = family_forward(
+                    params, c, tokens[:, None], lengths, cache, active=active,
+                    attention_fn=attn)
+                nt = sample(logits[:, 0, :], samp, sub)
+                nl = jnp.where(active, lengths + 1, lengths)
+                return (PagedKVCache(k=cache.k, v=cache.v), nt, nl, key), nt
+            (cache, tokens, lengths, key), toks = jax.lax.scan(
+                body, (cache, tokens, lengths, key), None, length=n_burst)
+            return toks, tokens, lengths, cache
+
         self._prefill_fn = prefill_step
         self._decode_fn = decode_step
+        self._decode_scan_fn = decode_scan if n_burst > 1 else None
         self._sample_one = _jit_sample_one()
 
     def _device_table(self) -> jax.Array:
@@ -542,25 +592,31 @@ class InferenceEngine:
                 top_k=jnp.asarray(self.samp_top_k))
             self._d_dirty = False
 
-        pending: list[jax.Array] = []
-        for _ in range(n_steps):
+        table = (self._device_table(),) if self.paged else ()
+        if n_steps == self.decode_burst and self._decode_scan_fn is not None:
+            # Full-size burst → the single fused scan program (one dispatch,
+            # one fetch). Partial bursts (tail of a request's token budget,
+            # or prefill work pending) fall through to the step loop below.
             self._rng, key = jax.random.split(self._rng)
-            if self.paged:
+            toks, self._d_tokens, self._d_lengths, self.cache = \
+                self._decode_scan_fn(
+                    self.params, self.cache, *table, self._d_tokens,
+                    self._d_lengths, self._d_active, self._d_samp, key)
+            host = np.asarray(toks)                      # [n_steps, B]
+            step_tokens = [host[i] for i in range(n_steps)]
+        else:
+            pending: list[jax.Array] = []
+            for _ in range(n_steps):
+                self._rng, key = jax.random.split(self._rng)
                 self._d_tokens, self._d_lengths, self.cache = self._decode_fn(
-                    self.params, self.cache, self._device_table(),
-                    self._d_tokens, self._d_lengths, self._d_active,
-                    self._d_samp, key)
-            else:
-                self._d_tokens, self._d_lengths, self.cache = self._decode_fn(
-                    self.params, self.cache, self._d_tokens, self._d_lengths,
-                    self._d_active, self._d_samp, key)
-            try:
-                self._d_tokens.copy_to_host_async()
-            except Exception:       # backend without async copies
-                pass
-            pending.append(self._d_tokens)
-
-        step_tokens = [np.asarray(t) for t in pending]
+                    self.params, self.cache, *table, self._d_tokens,
+                    self._d_lengths, self._d_active, self._d_samp, key)
+                try:
+                    self._d_tokens.copy_to_host_async()
+                except Exception:       # backend without async copies
+                    pass
+                pending.append(self._d_tokens)
+            step_tokens = [np.asarray(t) for t in pending]
         # Mirror device-side length advance on the host.
         self.lengths[self.active] += n_steps
         for slot in np.nonzero(self.active)[0]:

@@ -142,6 +142,16 @@ def insert_kv(layer_k: jax.Array, layer_v: jax.Array, k_new: jax.Array,
     the prefill path. The ONE copy of this layout-sensitive invariant —
     both the jnp and the Pallas attention paths go through it.
     """
+    # Inactive rows: instead of a full-cache `where` (which copies every
+    # byte of the cache each step), route their write to the row TAIL via
+    # offset clamping (dynamic_update_slice clamps start to S-T). Tail
+    # positions are never visible before being rewritten: position p is only
+    # attended once some step has length >= p, and that step (prefill chunk
+    # or decode insert at offset p) writes p first.
+    if active is not None:
+        S = layer_k.shape[2]
+        lengths = jnp.where(active, lengths, S)
+
     def insert(cache_row, new_row, offset):
         # cache_row [KV, S, Dh]; new_row [T, KV, Dh] → [KV, T, Dh]
         return jax.lax.dynamic_update_slice(
@@ -149,10 +159,6 @@ def insert_kv(layer_k: jax.Array, layer_v: jax.Array, k_new: jax.Array,
             (0, offset, 0))
     inserted_k = jax.vmap(insert)(layer_k, k_new, lengths)
     inserted_v = jax.vmap(insert)(layer_v, v_new, lengths)
-    if active is not None:
-        keep = active[:, None, None, None]
-        inserted_k = jnp.where(keep, inserted_k, layer_k)
-        inserted_v = jnp.where(keep, inserted_v, layer_v)
     return inserted_k, inserted_v
 
 
@@ -177,13 +183,14 @@ def dense_cache_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     layer_k, layer_v = insert_kv(layer_k, layer_v, k_new, v_new,
                                  lengths, active)
 
-    # GQA: expand KV heads to H by repeat.
+    # GQA WITHOUT materializing repeated KV: group the query heads
+    # [B,T,H,Dh] → [B,KV,G,T,Dh] and contract each group against its single
+    # KV head. bf16 reads + fp32 MXU accumulation (preferred_element_type)
+    # — no fp32 copy of the cache, no 8× `repeat` traffic.
     group = H // KV
-    k_all = jnp.repeat(layer_k, group, axis=1)      # [B, H, S, Dh]
-    v_all = jnp.repeat(layer_v, group, axis=1)
-
-    qf = q.astype(jnp.float32)
-    scores = jnp.einsum("bthd,bhsd->bhts", qf, k_all.astype(jnp.float32))
+    qg = q.reshape(B, T, KV, group, Dh).transpose(0, 2, 3, 1, 4)
+    scores = jnp.einsum("bkgtd,bksd->bkgts", qg, layer_k,
+                        preferred_element_type=jnp.float32)
     scores = scores / jnp.sqrt(jnp.asarray(Dh, jnp.float32))
 
     # Mask: key position s is visible to query t iff s <= lengths + t.
@@ -192,11 +199,14 @@ def dense_cache_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     visible = s_idx <= q_pos[:, :, None]                        # [B, T, S]
     if active is not None:
         visible = visible & active[:, None, None]
-    scores = jnp.where(visible[:, None, :, :], scores, -1e30)
+    scores = jnp.where(visible[:, None, None, :, :], scores, -1e30)
 
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bhts,bhsd->bthd", probs, v_all.astype(jnp.float32))
-    return out.reshape(B, T, H * Dh).astype(q.dtype), layer_k, layer_v
+    out = jnp.einsum("bkgts,bksd->bkgtd", probs.astype(layer_v.dtype),
+                     layer_v, preferred_element_type=jnp.float32)
+    # [B,KV,G,T,Dh] → [B,T,H*Dh]
+    out = out.transpose(0, 3, 1, 2, 4).reshape(B, T, H * Dh)
+    return out.astype(q.dtype), layer_k, layer_v
 
 
 def swiglu_mlp(x: jax.Array, wg: jax.Array, wu: jax.Array,
@@ -260,5 +270,8 @@ def forward(params: Params, config: ModelConfig, tokens: jax.Array,
 
     x = rms_norm(x, params["final_norm"], c.rms_eps)
     head = params["embed"] if c.tie_embeddings else params["lm_head"]
-    logits = (x.astype(jnp.float32) @ head.astype(jnp.float32).T)
+    # bf16 reads of the [V, D] head with fp32 MXU accumulation — an explicit
+    # astype would materialize a full fp32 copy of the vocab matrix per step.
+    logits = jnp.einsum("btd,vd->btv", x, head,
+                        preferred_element_type=jnp.float32)
     return logits, KVCache(k=new_k, v=new_v)

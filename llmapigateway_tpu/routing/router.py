@@ -110,6 +110,11 @@ class ProviderRegistry:
                     self._cache[name] = (fingerprint, provider)
             return provider
 
+    def instantiated(self) -> list[tuple[str, Provider]]:
+        """Currently-built providers (without forcing any build) — for the
+        observability endpoints (server/profiler_api.py)."""
+        return [(name, prov) for name, (_, prov) in self._cache.items()]
+
     def _retire(self, provider: Provider) -> None:
         async def _close_later() -> None:
             try:

@@ -20,7 +20,7 @@ from ..db.rotation import RotationDB
 from ..db.usage import UsageDB
 from ..providers.base import Provider
 from ..routing.router import ProviderRegistry, Router
-from . import chat, config_api, models_api, stats_api
+from . import chat, config_api, models_api, profiler_api, stats_api
 from .middleware import (
     auth_middleware,
     cors_middleware,
@@ -111,6 +111,10 @@ def build_app(settings: Settings | None = None,
     # Stats API
     app.router.add_get("/v1/api/usage-stats/{period}", stats_api.get_usage_stats)
     app.router.add_get("/v1/api/usage-records", stats_api.get_usage_records)
+
+    # Observability: engine stats + on-demand device trace capture
+    app.router.add_get("/v1/api/engine-stats", profiler_api.get_engine_stats)
+    app.router.add_post("/v1/api/profiler/trace", profiler_api.capture_trace)
 
     if STATIC_DIR.exists():
         app.router.add_static("/static", STATIC_DIR)

@@ -1,0 +1,104 @@
+"""Observability API: engine stats and on-demand device trace capture.
+
+The reference has no tracing/profiling beyond a per-request UUID and
+duration log (SURVEY.md §5 "Tracing / profiling" — ``request_logging.py:23``,
+``:85-86``); the TPU build adds the device-side story the reference never
+needed: ``jax.profiler`` trace capture (viewable in TensorBoard/Perfetto)
+plus live serving-engine stats (slots, queue depth, paged-KV occupancy),
+since TTFT/throughput are north-star metrics here (BASELINE.md).
+
+Endpoints (wired in server/app.py):
+
+* ``GET  /v1/api/engine-stats`` — per-local-provider engine stats + device
+  inventory. Cheap; safe to poll.
+* ``POST /v1/api/profiler/trace?duration_ms=N`` — capture a profiler trace
+  of the next N ms of live traffic into ``<logs_dir>/profiles/<name>``;
+  returns the directory path. One capture at a time.
+"""
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from pathlib import Path
+from typing import Any
+
+from aiohttp import web
+
+logger = logging.getLogger(__name__)
+
+_trace_lock = asyncio.Lock()
+
+MAX_TRACE_MS = 30_000
+DEFAULT_TRACE_MS = 2_000
+
+
+def _local_engines(gw) -> list[tuple[str, Any]]:
+    out = []
+    for name, prov in gw.registry.instantiated():
+        engine = getattr(prov, "engine", None)
+        if engine is not None:
+            out.append((name, engine))
+    return out
+
+
+async def get_engine_stats(request: web.Request) -> web.Response:
+    gw = request.app["gateway"]
+    engines = {name: eng.stats() for name, eng in _local_engines(gw)}
+
+    def _devices() -> list[dict[str, Any]]:
+        # jax.devices() initializes the backend on first call (can take
+        # seconds and claims the TPU runtime) — never on the event loop.
+        try:
+            import jax
+            return [{"id": d.id, "platform": d.platform,
+                     "kind": d.device_kind} for d in jax.devices()]
+        except Exception:       # proxy-only deployment without JAX
+            return []
+    devices = await asyncio.to_thread(_devices)
+    return web.json_response({"engines": engines, "devices": devices})
+
+
+async def capture_trace(request: web.Request) -> web.Response:
+    try:
+        import jax
+    except Exception:
+        return web.json_response(
+            {"detail": "jax unavailable in this deployment"}, status=501)
+
+    try:
+        duration_ms = int(request.query.get("duration_ms", DEFAULT_TRACE_MS))
+    except ValueError:
+        return web.json_response({"detail": "duration_ms must be an integer"},
+                                 status=400)
+    duration_ms = max(100, min(duration_ms, MAX_TRACE_MS))
+
+    if _trace_lock.locked():
+        return web.json_response(
+            {"detail": "a trace capture is already running"}, status=409)
+
+    gw = request.app["gateway"]
+    logs_dir = Path(gw.settings.logs_dir or "logs")
+    out_dir = logs_dir / "profiles" / time.strftime("trace-%Y%m%d-%H%M%S")
+    out_dir.mkdir(parents=True, exist_ok=True)
+
+    async with _trace_lock:
+        logger.info("profiler: capturing %d ms trace to %s",
+                    duration_ms, out_dir)
+        # start/stop_trace do blocking work (stop serializes the whole
+        # device trace to disk — can be hundreds of MB) — keep it off the
+        # event loop so in-flight SSE streams don't stall.
+        await asyncio.to_thread(jax.profiler.start_trace, str(out_dir))
+        try:
+            # Sleep while live traffic runs under the trace; the engine loop
+            # and any in-flight requests keep executing on the event loop.
+            await asyncio.sleep(duration_ms / 1000.0)
+        finally:
+            await asyncio.to_thread(jax.profiler.stop_trace)
+
+    return web.json_response({
+        "trace_dir": str(out_dir),
+        "duration_ms": duration_ms,
+        "hint": "view with: tensorboard --logdir <trace_dir> "
+                "(Profile tab) or upload to ui.perfetto.dev",
+    })

@@ -251,3 +251,24 @@ async def test_request_id_header(tmp_path):
     async with Gateway(tmp_path) as g:
         resp = await g.client.get("/v1/models")
         assert "x-request-id" in resp.headers
+
+
+async def test_engine_stats_and_trace_capture(tmp_path):
+    async with Gateway(tmp_path) as g:
+        # Proxy-only deployment: no local engines built, devices listed.
+        resp = await g.client.get("/v1/api/engine-stats")
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["engines"] == {}
+        assert isinstance(body["devices"], list)
+
+        resp = await g.client.post("/v1/api/profiler/trace?duration_ms=150")
+        assert resp.status == 200
+        body = await resp.json()
+        trace_dir = Path(body["trace_dir"])
+        assert trace_dir.exists()
+        # jax.profiler writes a plugins/profile tree under the trace dir.
+        assert any(trace_dir.rglob("*")), "trace capture produced no files"
+
+        resp = await g.client.post("/v1/api/profiler/trace?duration_ms=nope")
+        assert resp.status == 400
