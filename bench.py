@@ -35,6 +35,12 @@ def main() -> None:
     args = ap.parse_args()
 
     import jax
+    # Honor JAX_PLATFORMS=cpu even where a site plugin re-forces the TPU
+    # platform after env parsing (config pin wins; the env var alone is
+    # overridden) — lets the bench run on CPU for smoke tests.
+    import os
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     import numpy as np
 
@@ -57,10 +63,22 @@ def main() -> None:
     t0 = time.monotonic()
     prompt = rng.integers(0, engine.model_cfg.vocab_size,
                           size=args.prompt_len).astype(np.int32)
+    # Exact decode-step count the warmup + timed loop below will run (the
+    # warmup always covers one full burst and the tail size): the paged
+    # reservation must cover every step or the tail would silently write
+    # through the trash page.
+    burst = max(1, engine.decode_burst)
+    tail = args.steps % burst
+    warmup_steps = burst + tail + (max(0, args.warmup - burst - tail)
+                                   // burst) * burst
+    total_tokens = len(prompt) + warmup_steps + args.steps + 1
+    if total_tokens > S:
+        sys.exit(f"--seq {S} too small for {len(prompt)} prompt + "
+                 f"{warmup_steps + args.steps} decode steps")
     for slot in range(B):
         if engine.paged:
-            engine.allocator.allocate(slot, min(
-                len(prompt) + args.steps + args.warmup + 1, S))
+            if not engine.allocator.allocate(slot, total_tokens):
+                sys.exit("paged KV pool too small for benchmark shape")
             engine._table_dirty = True
         pos = 0
         while pos < len(prompt):
@@ -89,11 +107,10 @@ def main() -> None:
     # not reliably sync through the axon TPU tunnel), and it matches serving,
     # which reads every token it streams out.
     engine._d_dirty = True
-    burst = max(1, engine.decode_burst)
     # Warmup must compile every program the timed loop will use: the fused
     # scan (full bursts) AND the per-step fallback (a non-multiple tail).
+    # (`burst`/`tail`/`warmup_steps` computed above for the KV reservation.)
     engine._decode_burst(burst)
-    tail = args.steps % burst
     if tail:
         engine._decode_burst(tail)
     for _ in range(max(0, args.warmup - burst - tail) // burst):

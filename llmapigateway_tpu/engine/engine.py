@@ -232,46 +232,25 @@ class InferenceEngine:
                 cache.v, row_cache.v, slot, axis=1)
             return logits[0], llama.KVCache(k=new_k, v=new_v)
 
-        @partial(jax.jit, donate_argnums=(1,))
-        def decode_step(params, cache: llama.KVCache, tokens: jax.Array,
-                        lengths: jax.Array, active: jax.Array,
-                        samp: SamplingParams, key: jax.Array
-                        ) -> tuple[jax.Array, jax.Array, llama.KVCache]:
-            """One decode step. Returns (next_tokens, new_lengths, cache) so
-            the token/length feedback loop stays ON DEVICE across steps —
-            host fetches happen asynchronously, steps behind (the tunnel's
-            per-fetch latency is ~40 ms; chained dispatch amortizes it)."""
+        def one_step(params, cache: llama.KVCache, tokens: jax.Array,
+                     lengths: jax.Array, active: jax.Array,
+                     samp: SamplingParams, key: jax.Array
+                     ) -> tuple[jax.Array, jax.Array, llama.KVCache]:
+            """One decode step — the ONE copy of the forward+sample+advance
+            body; both compiled programs below are built from it. Returns
+            (next_tokens, new_lengths, cache) so the token/length feedback
+            loop stays ON DEVICE across steps — host fetches happen
+            asynchronously, steps behind (the tunnel's per-fetch latency is
+            ~40 ms; chained dispatch amortizes it)."""
             logits, cache = model_forward(
                 params, c, tokens[:, None], lengths, cache, active=active)
             next_tokens = sample(logits[:, 0, :], samp, key)
             new_lengths = jnp.where(active, lengths + 1, lengths)
             return next_tokens, new_lengths, cache
 
-        n_burst = self.decode_burst
-
-        @partial(jax.jit, donate_argnums=(1,))
-        def decode_scan(params, cache: llama.KVCache, tokens: jax.Array,
-                        lengths: jax.Array, active: jax.Array,
-                        samp: SamplingParams, key: jax.Array):
-            """A full decode burst as ONE compiled program (lax.scan over
-            `decode_burst` steps): one dispatch + one host fetch per burst
-            instead of per step — through a remote-device tunnel, dispatch
-            latency is the decode bottleneck, not FLOPs."""
-            def body(carry, _):
-                cache, tokens, lengths, key = carry
-                key, sub = jax.random.split(key)
-                logits, cache = model_forward(
-                    params, c, tokens[:, None], lengths, cache, active=active)
-                nt = sample(logits[:, 0, :], samp, sub)
-                nl = jnp.where(active, lengths + 1, lengths)
-                return (cache, nt, nl, key), nt
-            (cache, tokens, lengths, key), toks = jax.lax.scan(
-                body, (cache, tokens, lengths, key), None, length=n_burst)
-            return toks, tokens, lengths, cache
-
         self._prefill_fn = prefill_step
-        self._decode_fn = decode_step
-        self._decode_scan_fn = decode_scan if n_burst > 1 else None
+        self._decode_fn, self._decode_scan_fn = _decode_programs(
+            one_step, self.decode_burst)
         self._sample_one = _jit_sample_one()
 
     def _resolve_attention_impl(self) -> str:
@@ -313,11 +292,14 @@ class InferenceEngine:
                 params, c, tokens, start_len[None], cache, attention_fn=attn)
             return logits[0], PagedKVCache(k=cache.k, v=cache.v)
 
-        @partial(jax.jit, donate_argnums=(1,))
-        def decode_step(params, cache: PagedKVCache, table: jax.Array,
-                        tokens: jax.Array, lengths: jax.Array,
-                        active: jax.Array, samp: SamplingParams,
-                        key: jax.Array):
+        def one_step(params, cache: PagedKVCache, table: jax.Array,
+                     tokens: jax.Array, lengths: jax.Array,
+                     active: jax.Array, samp: SamplingParams,
+                     key: jax.Array):
+            """Paged one-step twin (page table routes the cache rows). The
+            table is loop-invariant under the burst scan — pages are
+            reserved for a request's whole lifetime at admission, so no
+            page can change mid-burst."""
             attn = make_paged_attention_fn(table, max_seq=S, impl=impl,
                                            mesh=mesh)
             logits, cache = family_forward(
@@ -328,35 +310,9 @@ class InferenceEngine:
             return (next_tokens, new_lengths,
                     PagedKVCache(k=cache.k, v=cache.v))
 
-        n_burst = self.decode_burst
-
-        @partial(jax.jit, donate_argnums=(1,))
-        def decode_scan(params, cache: PagedKVCache, table: jax.Array,
-                        tokens: jax.Array, lengths: jax.Array,
-                        active: jax.Array, samp: SamplingParams,
-                        key: jax.Array):
-            """Full decode burst as one program (see dense twin): the page
-            table is loop-invariant — pages are reserved for a request's
-            whole lifetime at admission, so no page can change mid-burst."""
-            attn = make_paged_attention_fn(table, max_seq=S, impl=impl,
-                                           mesh=mesh)
-
-            def body(carry, _):
-                cache, tokens, lengths, key = carry
-                key, sub = jax.random.split(key)
-                logits, cache = family_forward(
-                    params, c, tokens[:, None], lengths, cache, active=active,
-                    attention_fn=attn)
-                nt = sample(logits[:, 0, :], samp, sub)
-                nl = jnp.where(active, lengths + 1, lengths)
-                return (PagedKVCache(k=cache.k, v=cache.v), nt, nl, key), nt
-            (cache, tokens, lengths, key), toks = jax.lax.scan(
-                body, (cache, tokens, lengths, key), None, length=n_burst)
-            return toks, tokens, lengths, cache
-
         self._prefill_fn = prefill_step
-        self._decode_fn = decode_step
-        self._decode_scan_fn = decode_scan if n_burst > 1 else None
+        self._decode_fn, self._decode_scan_fn = _decode_programs(
+            one_step, self.decode_burst)
         self._sample_one = _jit_sample_one()
 
     def _device_table(self) -> jax.Array:
@@ -713,6 +669,32 @@ class InferenceEngine:
             out["total_pages"] = self.allocator.num_pages - 1
             out["page_size"] = self.allocator.page_size
         return out
+
+
+def _decode_programs(one_step, n_burst: int):
+    """Compile the two decode programs from one step body: the per-step
+    program, and (when bursting) the fused lax.scan over `n_burst` steps —
+    ONE dispatch + ONE host fetch per burst instead of per step; through a
+    remote-device tunnel, dispatch latency is the decode bottleneck, not
+    FLOPs. `one_step(params, cache, [table,] tokens, lengths, active, samp,
+    key) -> (next_tokens, new_lengths, cache)`."""
+    decode_step = partial(jax.jit, donate_argnums=(1,))(one_step)
+
+    @partial(jax.jit, donate_argnums=(1,))
+    def decode_scan(params, cache, *rest):
+        *table, tokens, lengths, active, samp, key = rest
+
+        def body(carry, _):
+            cache, tokens, lengths, key = carry
+            key, sub = jax.random.split(key)
+            nt, nl, cache = one_step(params, cache, *table, tokens, lengths,
+                                     active, samp, sub)
+            return (cache, nt, nl, key), nt
+        (cache, tokens, lengths, key), toks = jax.lax.scan(
+            body, (cache, tokens, lengths, key), None, length=n_burst)
+        return toks, tokens, lengths, cache
+
+    return decode_step, (decode_scan if n_burst > 1 else None)
 
 
 def _jit_sample_one():

@@ -26,7 +26,11 @@ from jax.sharding import Mesh
 
 logger = logging.getLogger(__name__)
 
-AXIS_ORDER = ("data", "expert", "seq", "model")   # slowest → fastest varying
+AXIS_ORDER = ("pipe", "data", "expert", "seq", "model")   # slowest → fastest
+# `pipe` (PP stages) is outermost: stage-to-stage traffic is one activation
+# hand-off per microbatch tick — the least-frequent collective — so it is
+# the axis to lay across hosts/DCN; `model` stays innermost on adjacent ICI
+# neighbors.
 
 
 @dataclass

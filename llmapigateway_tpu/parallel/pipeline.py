@@ -1,0 +1,199 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a ``pipe``
+mesh axis, expressed with ``shard_map`` + ``jax.lax.ppermute``.
+
+SURVEY.md §2b "Pipeline Parallelism (PP)" row: layer-blocked params +
+collective-permute microbatching. The reference has no counterpart (it has
+no parallelism of any kind — SURVEY.md §2b); this is the TPU-native
+equivalent of the stage-to-stage p2p a GPU framework would run over
+NCCL send/recv.
+
+Design:
+
+* Params stay in the stacked-layer layout ``[L, ...]`` (models/llama.py)
+  and shard the layer dim over ``pipe`` (parallel/sharding.py) — stage ``p``
+  holds the contiguous block of layers ``[p·L/P, (p+1)·L/P)``. The KV cache
+  shards the same way, so a stage only ever touches its own layers' cache.
+* The batch is split into ``M`` microbatches. One forward = ``M + P - 1``
+  ticks; at tick ``t`` stage ``p`` runs microbatch ``m = t - p`` through its
+  layer block, then hands the activation to stage ``p+1`` via ``ppermute``
+  (one hop per tick — rides whatever link the ``pipe`` axis is laid on,
+  ideally DCN across hosts).
+* Bubble ticks (``t - p`` outside ``[0, M)``) compute on a zero activation
+  with ``active=False``, so their cache writes are routed to the
+  never-visible row tail (models/llama.py ``insert_kv`` invariant) — no
+  masking pass over the cache is ever needed.
+* Embedding and the LM head are replicated on every stage: each stage
+  embeds its own microbatch input (stage 0's is the only real one) and the
+  last stage's logits are broadcast to all stages with a masked ``psum``,
+  so the caller sees a fully-replicated ``[B, T, V]`` — the same contract
+  as the non-pipelined forward.
+
+Tested against the sequential forward on a virtual CPU mesh
+(tests/test_pipeline.py) — same logits, same cache, bubbles and all.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models import llama
+from ..models.config import ModelConfig
+
+
+def stage_size(n_layers: int, n_stages: int) -> int:
+    if n_layers % n_stages != 0:
+        raise ValueError(
+            f"n_layers={n_layers} not divisible by pipe={n_stages} stages")
+    return n_layers // n_stages
+
+
+def _block_forward(lp_block: dict, c: ModelConfig, x: jax.Array,
+                   lengths: jax.Array, k_block: jax.Array,
+                   v_block: jax.Array, active: jax.Array,
+                   cos: jax.Array, sin: jax.Array
+                   ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Run one stage's layer block: scan over the local layers.
+    x [Bm, T, D]; k/v_block [Lp, Bm, KV, S, Dh]."""
+    B, T, _ = x.shape
+    dh = c.head_dim
+
+    def layer_step(x, scanned):
+        lp, layer_k, layer_v = scanned
+        h = llama.rms_norm(x, lp["attn_norm"], c.rms_eps)
+        q = (h @ lp["wq"]).reshape(B, T, c.n_heads, dh)
+        k = (h @ lp["wk"]).reshape(B, T, c.n_kv_heads, dh)
+        v = (h @ lp["wv"]).reshape(B, T, c.n_kv_heads, dh)
+        q = llama.apply_rope(q, cos, sin)
+        k = llama.apply_rope(k, cos, sin)
+        attn, layer_k, layer_v = llama.dense_cache_attention(
+            q, k, v, layer_k, layer_v, lengths, active)
+        x = x + attn @ lp["wo"]
+        h = llama.rms_norm(x, lp["mlp_norm"], c.rms_eps)
+        x = x + llama.swiglu_mlp(h, lp["wg"], lp["wu"], lp["wd"])
+        return x, (layer_k, layer_v)
+
+    x, (new_k, new_v) = jax.lax.scan(layer_step, x, (lp_block, k_block, v_block))
+    return x, new_k, new_v
+
+
+@functools.lru_cache(maxsize=32)
+def _build_run(c: ModelConfig, mesh: Mesh, n_stages: int, M: int, Bm: int,
+               T: int, has_lm_head: bool):
+    """Build (once per signature) the jitted shard_map pipeline program.
+    jax.jit caches by function identity, so the closure must be memoized —
+    a fresh closure per call would retrace/recompile every invocation."""
+    B = M * Bm
+    # Spec prefix-trees: P("pipe") applies to every leaf under "layers".
+    param_spec = {"embed": P(), "final_norm": P(), "layers": P("pipe")}
+    if has_lm_head:
+        param_spec["lm_head"] = P()
+    in_specs = (
+        param_spec,
+        P(),                     # tokens (replicated; every stage embeds)
+        P(),                     # lengths
+        P("pipe"), P("pipe"),    # cache k, v (layer dim)
+        P(),                     # active
+    )
+    out_specs = (P(), P("pipe"), P("pipe"))
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        axis_names={"pipe"}, check_vma=False)
+    def run(params, tokens, lengths, cache_k, cache_v, active):
+        p = jax.lax.axis_index("pipe")
+        lp = params["layers"]                  # [Lp, ...] local block
+
+        # Every stage embeds every microbatch (replicated compute, tiny):
+        # [M, Bm, T, D].
+        x_all = jnp.take(params["embed"], tokens, axis=0).reshape(M, Bm, T, -1)
+        positions = (lengths[:, None] + jnp.arange(T)[None, :])     # [B, T]
+        cos_all, sin_all = llama.rope_tables(positions, c.head_dim,
+                                             c.rope_theta)
+        cos_all = cos_all.reshape(M, Bm, T, -1)
+        sin_all = sin_all.reshape(M, Bm, T, -1)
+        len_all = lengths.reshape(M, Bm)
+        act_all = active.reshape(M, Bm)
+
+        n_ticks = M + n_stages - 1
+
+        def tick(t, carry):
+            inbuf, cache_k, cache_v, outs = carry
+            m = t - p                               # this stage's microbatch
+            valid = (m >= 0) & (m < M)
+            mc = jnp.clip(m, 0, M - 1)
+            # Stage 0 reads its own embedding; later stages read the
+            # ppermuted activation from the previous stage.
+            x_in = jnp.where(p == 0, x_all[mc], inbuf)
+            mb_len = len_all[mc]
+            mb_act = act_all[mc] & valid            # bubbles → tail writes
+            k_rows = jax.lax.dynamic_slice_in_dim(cache_k, mc * Bm, Bm, 1)
+            v_rows = jax.lax.dynamic_slice_in_dim(cache_v, mc * Bm, Bm, 1)
+            y, k_rows, v_rows = _block_forward(
+                lp, c, x_in, mb_len, k_rows, v_rows, mb_act,
+                cos_all[mc], sin_all[mc])
+            cache_k = jax.lax.dynamic_update_slice_in_dim(
+                cache_k, k_rows, mc * Bm, 1)
+            cache_v = jax.lax.dynamic_update_slice_in_dim(
+                cache_v, v_rows, mc * Bm, 1)
+            # Last stage collects its finished microbatch.
+            take = valid & (p == n_stages - 1)
+            outs = jax.lax.cond(
+                take,
+                lambda o: jax.lax.dynamic_update_slice_in_dim(
+                    o, y[None], mc, 0),
+                lambda o: o, outs)
+            # Hand the activation to the next stage (ring permute; the
+            # wrap-around hop P-1 → 0 carries a bubble, never real data).
+            inbuf = jax.lax.ppermute(
+                y, "pipe",
+                [(i, (i + 1) % n_stages) for i in range(n_stages)])
+            return inbuf, cache_k, cache_v, outs
+
+        inbuf = jnp.zeros_like(x_all[0])
+        outs = jnp.zeros_like(x_all)
+        inbuf, cache_k, cache_v, outs = jax.lax.fori_loop(
+            0, n_ticks, tick, (inbuf, cache_k, cache_v, outs))
+
+        # Final norm + head on the last stage's collected activations;
+        # masked psum broadcasts the logits to every stage.
+        x = outs.reshape(B, T, -1)
+        x = llama.rms_norm(x, params["final_norm"], c.rms_eps)
+        head = params["embed"] if c.tie_embeddings else params["lm_head"]
+        logits = jnp.einsum("btd,vd->btv", x, head,
+                            preferred_element_type=jnp.float32)
+        logits = jnp.where(p == n_stages - 1, logits, 0.0)
+        logits = jax.lax.psum(logits, "pipe")
+        return logits, cache_k, cache_v
+
+    # Partially-manual shard_map (axis_names ⊂ mesh axes, so GSPMD keeps
+    # managing e.g. the `model` axis inside each stage) only traces under
+    # jit in current JAX.
+    return jax.jit(run)
+
+
+def pipelined_forward(params: dict, config: ModelConfig, tokens: jax.Array,
+                      lengths: jax.Array, cache: llama.KVCache, mesh: Mesh,
+                      n_microbatches: int,
+                      active: jax.Array | None = None
+                      ) -> tuple[jax.Array, llama.KVCache]:
+    """Pipelined equivalent of ``llama.forward`` over the mesh's ``pipe``
+    axis. Same signature contract: tokens [B, T] → (logits [B, T, V] fp32
+    replicated, updated cache). B must divide into ``n_microbatches``.
+    """
+    B, T = tokens.shape
+    n_stages = mesh.shape.get("pipe", 1)
+    stage_size(config.n_layers, n_stages)     # validate divisibility
+    M = n_microbatches
+    if B % M != 0:
+        raise ValueError(f"batch {B} not divisible by {M} microbatches")
+    if active is None:
+        active = jnp.ones((B,), bool)
+    run = _build_run(config, mesh, n_stages, M, B // M, T,
+                     "lm_head" in params)
+    logits, new_k, new_v = run(params, tokens, lengths, cache.k, cache.v,
+                               active)
+    return logits, llama.KVCache(k=new_k, v=new_v)

@@ -44,21 +44,24 @@ def _spec_for(path: str, shape: tuple[int, ...], mesh: Mesh) -> P:
         return P(None)
     if path.startswith("layers."):
         key = path.split(".", 1)[1]
+        # Stacked layer dim (dim 0) shards over `pipe` when PP is on: each
+        # stage holds a contiguous block of layers (parallel/pipeline.py).
+        lp = _axis(mesh, "pipe", shape[0])
         if key in ("attn_norm", "mlp_norm"):
-            return P(None, None)
+            return P(lp, None)
         if key == "router":                       # [L, D, E]
-            return P(None, None, None)
+            return P(lp, None, None)
         n = len(shape)
         if key in ("wq", "wk", "wv", "wg", "wu"):
             if n == 4:                            # MoE expert: [L, E, D, F]
-                return P(None, _axis(mesh, "expert", shape[1]), None,
+                return P(lp, _axis(mesh, "expert", shape[1]), None,
                          _axis(mesh, "model", shape[3]))
-            return P(None, None, _axis(mesh, "model", shape[2]))
+            return P(lp, None, _axis(mesh, "model", shape[2]))
         if key in ("wo", "wd"):
             if n == 4:                            # [L, E, F, D]
-                return P(None, _axis(mesh, "expert", shape[1]),
+                return P(lp, _axis(mesh, "expert", shape[1]),
                          _axis(mesh, "model", shape[2]), None)
-            return P(None, _axis(mesh, "model", shape[1]), None)
+            return P(lp, _axis(mesh, "model", shape[1]), None)
     logger.debug("no sharding rule for %s %s; replicating", path, shape)
     return P()
 
@@ -92,11 +95,13 @@ def spec_for_param(path: str, shape: tuple[int, ...], mesh: Mesh) -> NamedShardi
     return NamedSharding(mesh, _spec_for(path, shape, mesh))
 
 
-def cache_sharding(mesh: Mesh, n_kv_heads: int, batch: int) -> NamedSharding:
-    """KV cache [L, B, KV, S, Dh] (head-major): batch on data, KV heads on
-    model."""
+def cache_sharding(mesh: Mesh, n_kv_heads: int, batch: int,
+                   n_layers: int = 0) -> NamedSharding:
+    """KV cache [L, B, KV, S, Dh] (head-major): layers on pipe (PP), batch
+    on data, KV heads on model."""
     return NamedSharding(mesh, P(
-        None, _axis(mesh, "data", batch),
+        _axis(mesh, "pipe", n_layers) if n_layers else None,
+        _axis(mesh, "data", batch),
         _axis(mesh, "model", n_kv_heads), None, None))
 
 
