@@ -41,12 +41,10 @@ def main() -> None:
     import os
     if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
         jax.config.update("jax_platforms", "cpu")
-    import jax.numpy as jnp
     import numpy as np
 
     from llmapigateway_tpu.config.schemas import LocalEngineConfig
     from llmapigateway_tpu.engine.engine import InferenceEngine
-    from llmapigateway_tpu.engine.sampling import SamplingParams
 
     eng_cfg = LocalEngineConfig(
         preset=args.preset, dtype="bfloat16", max_batch_size=args.batch,
@@ -83,21 +81,12 @@ def main() -> None:
         pos = 0
         while pos < len(prompt):
             chunk = prompt[pos:pos + engine.prefill_chunk]
-            padded = np.zeros((1, engine.prefill_chunk), np.int32)
-            padded[0, :len(chunk)] = chunk
-            if engine.paged:
-                logits, engine.cache = engine._prefill_fn(
-                    engine.params, engine.cache, engine._device_table(),
-                    jnp.asarray(padded), jnp.int32(pos), jnp.int32(slot))
-            else:
-                logits, engine.cache = engine._prefill_fn(
-                    engine.params, engine.cache, jnp.asarray(padded),
-                    jnp.int32(pos), jnp.int32(slot))
+            row, engine.cache = engine._exec_prefill(slot, pos, chunk)
             pos += len(chunk)
         engine.lengths[slot] = len(prompt)
         engine.active[slot] = True
         engine.last_token[slot] = 1
-        np.asarray(logits[:1, :1])       # real sync (see NOTE below)
+        np.asarray(row[:1])              # real sync (see NOTE below)
     prefill_s = time.monotonic() - t0
     prefill_tok_s = B * args.prompt_len / prefill_s
 

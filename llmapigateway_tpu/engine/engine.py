@@ -36,6 +36,7 @@ from typing import Any, AsyncIterator
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..config.schemas import LocalEngineConfig
 from ..models import forward_fn, init_fn, llama
@@ -117,6 +118,20 @@ class InferenceEngine:
             raise ValueError(f"unknown kv_layout {engine_cfg.kv_layout!r}")
         self.paged = engine_cfg.kv_layout == "paged"
 
+        # Multi-host: process 0 runs the scheduler and publishes every
+        # compiled-program call; followers replay (parallel/multihost.py).
+        from ..parallel.multihost import HostBridge
+        self._bridge = HostBridge(self.B, self.prefill_chunk)
+        if self._bridge.enabled and self.paged:
+            raise ValueError(
+                "multihost serving currently requires kv_layout=contiguous "
+                "(the page table is not yet broadcast to followers)")
+        if self.mesh.shape.get("pipe", 1) > 1:
+            raise ValueError(
+                "the serving engine shards DP/TP/EP; pipeline stages are "
+                "provided by parallel.pipeline.pipelined_forward and are "
+                "not yet wired into the engine's compiled programs")
+
         self.tokenizer = load_tokenizer(
             engine_cfg.tokenizer_path or engine_cfg.model_path or None,
             vocab_size=model_cfg.vocab_size)
@@ -139,12 +154,13 @@ class InferenceEngine:
     def _init_params(self) -> None:
         c = self.model_cfg
         t0 = time.monotonic()
+        from ..parallel.multihost import put_global
         if self.cfg.model_path:
             from .checkpoint import load_checkpoint
             from ..parallel.sharding import spec_for_param
 
             def put(path: str, arr: np.ndarray) -> jax.Array:
-                return jax.device_put(
+                return put_global(
                     arr, spec_for_param(path, tuple(arr.shape), self.mesh))
             self.params = load_checkpoint(self.cfg.model_path, c,
                                           dtype=self.dtype, put=put)
@@ -152,7 +168,7 @@ class InferenceEngine:
             key = jax.random.PRNGKey(0)
             host_params = init_fn(c)(c, key, dtype=self.dtype)
             shardings = param_shardings(host_params, self.mesh)
-            self.params = jax.tree.map(jax.device_put, host_params, shardings)
+            self.params = jax.tree.map(put_global, host_params, shardings)
         n_params = sum(int(np.prod(p.shape))
                        for p in jax.tree.leaves(self.params))
         logger.info("params ready: %.2fB parameters in %.1fs",
@@ -181,11 +197,12 @@ class InferenceEngine:
             self._d_table = None
             self._table_dirty = True
         else:
+            from ..parallel.multihost import zeros_global
             csh = cache_sharding(self.mesh, c.n_kv_heads, self.B)
             shape = (c.n_layers, self.B, c.n_kv_heads, self.S, c.head_dim)
             self.cache = llama.KVCache(
-                k=jax.device_put(jnp.zeros(shape, self.dtype), csh),
-                v=jax.device_put(jnp.zeros(shape, self.dtype), csh))
+                k=zeros_global(shape, self.dtype, csh),
+                v=zeros_global(shape, self.dtype, csh))
         # Host-authoritative per-slot state, mirrored to device each step.
         self.lengths = np.zeros((self.B,), np.int32)
         self.active = np.zeros((self.B,), bool)
@@ -214,11 +231,18 @@ class InferenceEngine:
         else:
             model_forward = partial(family_forward, attention_fn=attention_fn)
 
+        replicated = NamedSharding(self.mesh, P())
+
         @partial(jax.jit, donate_argnums=(1,))
         def prefill_step(params, cache: llama.KVCache, tokens: jax.Array,
-                         start_len: jax.Array, slot: jax.Array
+                         start_len: jax.Array, slot: jax.Array,
+                         last_idx: jax.Array
                          ) -> tuple[jax.Array, llama.KVCache]:
-            """Run one prompt chunk for one slot. tokens [1, C]."""
+            """Run one prompt chunk for one slot. tokens [1, C]. Returns
+            only the last REAL position's logits row [V], replicated — the
+            single row the scheduler samples from; fetching (or indexing)
+            anything else on the host would be a global op that every
+            process in a multi-host deployment must join."""
             # Slice this slot's cache rows: [L, 1, KV, S, Dh].
             k_row = jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1)
             v_row = jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1)
@@ -230,7 +254,10 @@ class InferenceEngine:
                 cache.k, row_cache.k, slot, axis=1)
             new_v = jax.lax.dynamic_update_slice_in_dim(
                 cache.v, row_cache.v, slot, axis=1)
-            return logits[0], llama.KVCache(k=new_k, v=new_v)
+            row = jax.lax.with_sharding_constraint(
+                jax.lax.dynamic_index_in_dim(logits[0], last_idx, 0,
+                                             keepdims=False), replicated)
+            return row, llama.KVCache(k=new_k, v=new_v)
 
         def one_step(params, cache: llama.KVCache, tokens: jax.Array,
                      lengths: jax.Array, active: jax.Array,
@@ -241,10 +268,13 @@ class InferenceEngine:
             (next_tokens, new_lengths, cache) so the token/length feedback
             loop stays ON DEVICE across steps — host fetches happen
             asynchronously, steps behind (the tunnel's per-fetch latency is
-            ~40 ms; chained dispatch amortizes it)."""
+            ~40 ms; chained dispatch amortizes it). Sampled tokens are
+            pinned replicated so the host fetch is local on every process
+            of a multi-host mesh."""
             logits, cache = model_forward(
                 params, c, tokens[:, None], lengths, cache, active=active)
-            next_tokens = sample(logits[:, 0, :], samp, key)
+            next_tokens = jax.lax.with_sharding_constraint(
+                sample(logits[:, 0, :], samp, key), replicated)
             new_lengths = jnp.where(active, lengths + 1, lengths)
             return next_tokens, new_lengths, cache
 
@@ -278,19 +308,26 @@ class InferenceEngine:
                     self.allocator.num_pages, self.allocator.page_size, impl)
         S = self.S
 
+        replicated = NamedSharding(self.mesh, P())
+
         @partial(jax.jit, donate_argnums=(1,))
         def prefill_step(params, cache: PagedKVCache, table: jax.Array,
                          tokens: jax.Array, start_len: jax.Array,
-                         slot: jax.Array) -> tuple[jax.Array, PagedKVCache]:
+                         slot: jax.Array, last_idx: jax.Array
+                         ) -> tuple[jax.Array, PagedKVCache]:
             """One prompt chunk for one slot. tokens [1, C]; the pool is
             global, so unlike the dense path there is no per-slot row slice
-            — the slot's page-table row does the routing."""
+            — the slot's page-table row does the routing. Returns the last
+            real position's logits row [V] (see dense twin)."""
             row = jax.lax.dynamic_slice_in_dim(table, slot, 1, axis=0)
             attn = make_paged_attention_fn(row, max_seq=S, impl=impl,
                                            mesh=mesh)
             logits, cache = family_forward(
                 params, c, tokens, start_len[None], cache, attention_fn=attn)
-            return logits[0], PagedKVCache(k=cache.k, v=cache.v)
+            out = jax.lax.with_sharding_constraint(
+                jax.lax.dynamic_index_in_dim(logits[0], last_idx, 0,
+                                             keepdims=False), replicated)
+            return out, PagedKVCache(k=cache.k, v=cache.v)
 
         def one_step(params, cache: PagedKVCache, table: jax.Array,
                      tokens: jax.Array, lengths: jax.Array,
@@ -305,7 +342,8 @@ class InferenceEngine:
             logits, cache = family_forward(
                 params, c, tokens[:, None], lengths, cache, active=active,
                 attention_fn=attn)
-            next_tokens = sample(logits[:, 0, :], samp, key)
+            next_tokens = jax.lax.with_sharding_constraint(
+                sample(logits[:, 0, :], samp, key), replicated)
             new_lengths = jnp.where(active, lengths + 1, lengths)
             return (next_tokens, new_lengths,
                     PagedKVCache(k=cache.k, v=cache.v))
@@ -351,6 +389,11 @@ class InferenceEngine:
         if self._loop_task is not None:
             await self._loop_task
             self._loop_task = None
+        # Only after the loop has fully drained: an in-flight burst's
+        # DECODE broadcast racing SHUTDOWN from another thread could reach
+        # followers out of order and strand them mid-collective.
+        if self._bridge.enabled:
+            await asyncio.to_thread(self._bridge.publish_shutdown)
         # Flush terminal deltas so no consumer awaits a stream forever.
         for req in list(self._running.values()):
             req.out_queue.put_nowait(Delta(error="engine stopped"))
@@ -402,6 +445,21 @@ class InferenceEngine:
                 for req in list(self._running.values()):
                     req.out_queue.put_nowait(Delta(error=f"engine failure: {e}"))
                     self._release(req)
+                if self._bridge.enabled:
+                    # Multihost: a local re-init would silently desync the
+                    # followers' cache shards (they saw no failure) and
+                    # every later SPMD call would compute garbage. The only
+                    # safe recovery is fleet shutdown; the gateway's
+                    # fallback chain takes over (provider error → remote).
+                    logger.error("multihost engine failure is fatal: "
+                                 "shutting the fleet down")
+                    self._stopped = True
+                    # Safe here: the failed burst's own broadcast completed
+                    # before its execution raised, and no other publisher
+                    # runs concurrently with this handler.
+                    await asyncio.to_thread(self._bridge.publish_shutdown)
+                    progressed = True
+                    continue
                 # donate_argnums may have consumed the cache buffer before
                 # the failure: rebuild device state so the engine recovers
                 # instead of failing every subsequent step on a deleted array.
@@ -496,30 +554,20 @@ class InferenceEngine:
             self.lengths[slot] = 0
             self.active[slot] = False
         chunk = np.asarray(ids[pos:pos + self.prefill_chunk], np.int32)
-        # Clamp the bucket so pos+bucket never exceeds the cache extent S:
-        # XLA clamps dynamic_update_slice start indices, so an overrunning
-        # padded chunk would silently shift and corrupt earlier KV entries.
-        # (Paged layout: out-of-range pad positions land on the trash page.)
-        bucket = min(_bucket(len(chunk), self.prefill_chunk), self.S - pos)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[:, :len(chunk)] = chunk
-        if self.paged:
-            logits, self.cache = self._prefill_fn(
-                self.params, self.cache, self._device_table(),
-                jnp.asarray(padded), jnp.int32(pos), jnp.int32(slot))
-        else:
-            logits, self.cache = self._prefill_fn(
-                self.params, self.cache, jnp.asarray(padded),
-                jnp.int32(pos), jnp.int32(slot))
+        self._bridge.publish_prefill(slot, pos, chunk)
+        row, self.cache = self._exec_prefill(slot, pos, chunk)
         req.prefill_pos = pos + len(chunk)
         if req.prefill_pos < len(ids):
             return False
 
-        # Prompt complete: sample the first token from the last real position.
+        # Prompt complete: sample the first token from the last real
+        # position — on the HOST-fetched row via a purely local program
+        # (followers never sample; the token reaches them inside the next
+        # decode burst's broadcast state).
         self._rng, key = jax.random.split(self._rng)
         first = self._sample_one(
-            logits[len(chunk) - 1], jnp.float32(req.temperature),
-            jnp.float32(req.top_p), jnp.int32(req.top_k), key)
+            np.asarray(row), np.float32(req.temperature),
+            np.float32(req.top_p), np.int32(req.top_k), key)
         first_id = int(first)
         req.generated.append(first_id)
         req.t_first_token = time.monotonic()
@@ -532,11 +580,92 @@ class InferenceEngine:
         self._d_dirty = True
         return True
 
+    def _exec_prefill(self, slot: int, pos: int, chunk: np.ndarray):
+        """The one compiled-prefill call — identical on coordinator and
+        followers (np/uncommitted inputs are auto-replicated, so the same
+        call works single-process and across a multi-host mesh). The
+        compile bucket is derived here, from (pos, len(chunk)) and engine
+        config, so coordinator/followers/bench can never disagree on it.
+        Clamped so pos+bucket never exceeds the cache extent S: XLA clamps
+        dynamic_update_slice starts, so an overrunning padded chunk would
+        silently shift and corrupt earlier KV entries. (Paged layout:
+        out-of-range pad positions land on the trash page.)"""
+        bucket = min(_bucket(len(chunk), self.prefill_chunk), self.S - pos)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[:, :len(chunk)] = chunk
+        table = (self._device_table(),) if self.paged else ()
+        return self._prefill_fn(
+            self.params, self.cache, *table, padded, np.int32(pos),
+            np.int32(slot), np.int32(len(chunk) - 1))
+
+    def _exec_decode(self, n_steps: int, state: dict) -> list[np.ndarray]:
+        """Run a burst from broadcast-packed host state (multihost path) —
+        identical on coordinator and followers."""
+        samp = SamplingParams(temperature=state["temperature"],
+                              top_p=state["top_p"], top_k=state["top_k"])
+        tokens = state["last_token"]
+        lengths = state["lengths"]
+        active = state["active"]
+        key = state["key"]
+        table = (self._device_table(),) if self.paged else ()
+        if n_steps == self.decode_burst and self._decode_scan_fn is not None:
+            toks, _, _, self.cache = self._decode_scan_fn(
+                self.params, self.cache, *table, tokens, lengths, active,
+                samp, key)
+            host = np.asarray(toks)
+            return [host[i] for i in range(n_steps)]
+        # Feedback stays as device arrays across the chain (outputs are
+        # pinned replicated, so the final fetches are process-local); only
+        # the sampled tokens are pulled to host, asynchronously behind the
+        # dispatch wave — same policy as the single-process path.
+        pending = []
+        for _ in range(n_steps):
+            key, sub = jax.random.split(key)
+            tokens, lengths, self.cache = self._decode_fn(
+                self.params, self.cache, *table, tokens, lengths, active,
+                samp, sub)
+            try:
+                tokens.copy_to_host_async()
+            except Exception:           # backend without async copies
+                pass
+            pending.append(tokens)
+        return [np.asarray(t) for t in pending]
+
+    def _follow_prefill(self, slot: int, pos: int,
+                        chunk: np.ndarray) -> None:
+        _, self.cache = self._exec_prefill(slot, pos, chunk)
+
+    def _follow_decode(self, n_steps: int, state: dict) -> None:
+        self._exec_decode(n_steps, state)
+
+    def run_follower(self) -> None:
+        """Blocking replay loop for follower processes (process_index > 0)
+        of a multi-host deployment: execute every compiled call the
+        coordinator publishes, until shutdown."""
+        self._bridge.follow(self._follow_prefill, self._follow_decode)
+
     def _decode_burst(self, n_steps: int) -> list[np.ndarray]:
         """Run `n_steps` chained decode steps; tokens/lengths feed back as
         device arrays (no host round-trip inside the chain) and each step's
         sampled tokens are fetched asynchronously behind the dispatch wave.
         Returns the per-step host token arrays, in order."""
+        if self._bridge.enabled:
+            # Multihost: broadcast the full slot state + rng key every
+            # burst (a few [B] vectors — negligible next to the decode
+            # itself) so coordinator and followers build bit-identical
+            # program inputs; then run the same _exec_decode both sides.
+            self._rng, key = jax.random.split(self._rng)
+            packed = self._bridge.pack_decode_state(
+                self.lengths, self.active, self.last_token, self.samp_top_k,
+                self.samp_temperature, self.samp_top_p, np.asarray(key))
+            self._bridge.publish_decode(n_steps, packed)
+            step_tokens = self._exec_decode(
+                n_steps, self._bridge.unpack_decode_state(packed))
+            self.lengths[self.active] += n_steps
+            for slot in np.nonzero(self.active)[0]:
+                self.last_token[slot] = int(step_tokens[-1][slot])
+            return step_tokens
+
         if self._d_dirty:
             # Host slot state changed (admission/release/prefill): upload once.
             self._d_tokens = jnp.asarray(self.last_token)

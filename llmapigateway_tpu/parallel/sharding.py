@@ -95,13 +95,13 @@ def spec_for_param(path: str, shape: tuple[int, ...], mesh: Mesh) -> NamedShardi
     return NamedSharding(mesh, _spec_for(path, shape, mesh))
 
 
-def cache_sharding(mesh: Mesh, n_kv_heads: int, batch: int,
-                   n_layers: int = 0) -> NamedSharding:
-    """KV cache [L, B, KV, S, Dh] (head-major): layers on pipe (PP), batch
-    on data, KV heads on model."""
+def cache_sharding(mesh: Mesh, n_kv_heads: int, batch: int) -> NamedSharding:
+    """KV cache [L, B, KV, S, Dh] (head-major): batch on data, KV heads on
+    model. (Pipeline stages shard the layer dim themselves —
+    parallel/pipeline.py builds its own specs; the serving engine rejects
+    pipe>1 meshes until PP is wired into its compiled programs.)"""
     return NamedSharding(mesh, P(
-        _axis(mesh, "pipe", n_layers) if n_layers else None,
-        _axis(mesh, "data", batch),
+        None, _axis(mesh, "data", batch),
         _axis(mesh, "model", n_kv_heads), None, None))
 
 

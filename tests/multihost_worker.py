@@ -1,0 +1,77 @@
+"""Subprocess worker for the multi-host serving test (test_multihost.py).
+
+Two of these run concurrently (process 0 = coordinator, 1 = follower) with
+a TP=4 mesh spanning both processes' CPU devices. The coordinator drives
+the REAL async engine (submit → stream → stop); the follower replays the
+broadcast commands. Both record every decode step's sampled tokens; at the
+end the coordinator broadcasts its record and each process asserts its own
+matches bit-for-bit — proving the two executed identical programs with
+identical inputs in lockstep.
+"""
+import os
+import sys
+
+PROC_ID = int(sys.argv[1])
+N_PROC = int(sys.argv[2])
+PORT = sys.argv[3]
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.distributed.initialize(coordinator_address=f"localhost:{PORT}",
+                           num_processes=N_PROC, process_id=PROC_ID)
+
+import asyncio  # noqa: E402
+
+import numpy as np  # noqa: E402
+from jax.experimental import multihost_utils  # noqa: E402
+
+from llmapigateway_tpu.config.schemas import LocalEngineConfig  # noqa: E402
+from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine  # noqa: E402
+
+MAX_REC = 64
+
+cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2, max_seq_len=64,
+                        prefill_chunk=8, decode_burst=4,
+                        mesh={"model": 4}, attention="reference")
+engine = InferenceEngine(cfg)
+assert engine._bridge.enabled, "bridge must be active with 2 processes"
+
+recorded: list[np.ndarray] = []
+_orig_exec = engine._exec_decode
+
+
+def _recording_exec(n_steps, state):
+    toks = _orig_exec(n_steps, state)
+    recorded.extend(toks)
+    return toks
+
+
+engine._exec_decode = _recording_exec
+
+if PROC_ID == 0:
+    async def main():
+        req = GenRequest(prompt_ids=[1, 2, 3, 4, 5], max_tokens=8,
+                         temperature=0.8, top_p=0.9)
+        await engine.submit(req)
+        async for _ in engine.stream(req):
+            pass
+        assert len(req.generated) >= 2, req.generated
+        await engine.stop()
+        return req
+
+    req = asyncio.run(main())
+else:
+    engine.run_follower()
+
+flat = np.full((MAX_REC,), -1, np.int32)
+mine = np.concatenate(recorded)[:MAX_REC] if recorded else np.zeros(0, np.int32)
+flat[:len(mine)] = mine
+theirs = np.asarray(multihost_utils.broadcast_one_to_all(flat))
+if PROC_ID != 0:
+    assert len(mine) > 0, "follower replayed no decode steps"
+    np.testing.assert_array_equal(theirs, flat)
+print(f"MULTIHOST_OK proc={PROC_ID} decode_tokens={len(mine)}", flush=True)
