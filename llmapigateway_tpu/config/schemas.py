@@ -44,6 +44,9 @@ class LocalEngineConfig(BaseModel):
     max_tokens_default: int = 1024
     attention: str = "auto"         # "auto" | "pallas" | "reference"
     tokenizer_path: str | None = None
+    # Numerics sanitizer (SURVEY.md §5 "race detection / sanitizers"): raise
+    # on NaN production inside compiled programs (costs performance; debug).
+    debug_nans: bool = False
 
 
 class ProviderDetails(BaseModel):

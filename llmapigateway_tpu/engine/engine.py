@@ -55,6 +55,32 @@ class EngineOverloaded(Exception):
 
 
 @dataclass
+class FaultPlan:
+    """Injectable engine faults (SURVEY.md §5 "failure detection / fault
+    injection": the reference tested failures by hand-editing code —
+    ``chat.py:143-144`` stubs; here they are first-class hooks). Attach via
+    ``engine.fault_plan = FaultPlan(...)``; counters track trigger points.
+    """
+    fail_prefill_after: int = -1    # raise after N prefill chunks (-1 = off)
+    fail_decode_after: int = -1     # raise after N decode bursts (-1 = off)
+    slow_decode_s: float = 0.0      # added latency per decode burst
+    prefill_calls: int = 0
+    decode_calls: int = 0
+
+    def on_prefill(self) -> None:
+        self.prefill_calls += 1
+        if 0 <= self.fail_prefill_after < self.prefill_calls:
+            raise RuntimeError("injected prefill fault")
+
+    def on_decode(self) -> None:
+        self.decode_calls += 1
+        if self.slow_decode_s > 0:
+            time.sleep(self.slow_decode_s)
+        if 0 <= self.fail_decode_after < self.decode_calls:
+            raise RuntimeError("injected decode fault")
+
+
+@dataclass
 class GenRequest:
     """One sequence's lifecycle inside the engine."""
     prompt_ids: list[int]
@@ -135,6 +161,12 @@ class InferenceEngine:
         self.tokenizer = load_tokenizer(
             engine_cfg.tokenizer_path or engine_cfg.model_path or None,
             vocab_size=model_cfg.vocab_size)
+
+        self.fault_plan: FaultPlan | None = None
+        if engine_cfg.debug_nans:
+            # The numerics sanitizer (SURVEY.md §5): compiled programs raise
+            # on NaN production instead of streaming garbage tokens.
+            jax.config.update("jax_debug_nans", True)
 
         self._init_params()
         self._init_state()
@@ -554,6 +586,8 @@ class InferenceEngine:
             self.lengths[slot] = 0
             self.active[slot] = False
         chunk = np.asarray(ids[pos:pos + self.prefill_chunk], np.int32)
+        if self.fault_plan:
+            self.fault_plan.on_prefill()
         self._bridge.publish_prefill(slot, pos, chunk)
         row, self.cache = self._exec_prefill(slot, pos, chunk)
         req.prefill_pos = pos + len(chunk)
@@ -649,6 +683,8 @@ class InferenceEngine:
         device arrays (no host round-trip inside the chain) and each step's
         sampled tokens are fetched asynchronously behind the dispatch wave.
         Returns the per-step host token arrays, in order."""
+        if self.fault_plan:
+            self.fault_plan.on_decode()
         if self._bridge.enabled:
             # Multihost: broadcast the full slot state + rng key every
             # burst (a few [B] vectors — negligible next to the decode

@@ -1,0 +1,59 @@
+"""Engine fault injection (SURVEY.md §5): injected prefill/decode failures
+must surface as clean error deltas (pre-commit failures → provider error →
+fallback; mid-stream failures → error frame), and the engine must recover
+to serve subsequent requests."""
+import asyncio
+
+import pytest
+
+from llmapigateway_tpu.config.schemas import LocalEngineConfig
+from llmapigateway_tpu.engine.engine import FaultPlan, GenRequest, InferenceEngine
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+                            max_seq_len=64, prefill_chunk=8, decode_burst=2)
+    return InferenceEngine(cfg)
+
+
+async def _run(engine, prompt_ids, max_tokens=6):
+    req = GenRequest(prompt_ids=prompt_ids, max_tokens=max_tokens)
+    await engine.submit(req)
+    deltas = []
+    async for d in engine.stream(req):
+        deltas.append(d)
+    return req, deltas
+
+
+async def test_prefill_fault_yields_error_before_any_text(engine):
+    engine.fault_plan = FaultPlan(fail_prefill_after=0)
+    try:
+        req, deltas = await _run(engine, [1, 2, 3])
+        assert deltas[-1].error is not None
+        assert all(not d.text for d in deltas)
+    finally:
+        engine.fault_plan = None
+    # Engine recovered: next request completes normally.
+    req, deltas = await _run(engine, [1, 2, 3])
+    assert req.finish_reason is not None and deltas[-1].error is None
+
+
+async def test_decode_fault_midstream_emits_error_and_recovers(engine):
+    engine.fault_plan = FaultPlan(fail_decode_after=1)
+    try:
+        req, deltas = await _run(engine, [4, 5, 6], max_tokens=16)
+        assert deltas[-1].error is not None
+    finally:
+        engine.fault_plan = None
+    req, deltas = await _run(engine, [4, 5, 6])
+    assert req.finish_reason is not None and deltas[-1].error is None
+
+
+async def test_slow_decode_still_completes(engine):
+    engine.fault_plan = FaultPlan(slow_decode_s=0.05)
+    try:
+        req, _ = await _run(engine, [7, 8], max_tokens=3)
+        assert req.finish_reason is not None
+    finally:
+        engine.fault_plan = None
