@@ -38,6 +38,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+import os as _os
+if _os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    # Honor JAX_PLATFORMS=cpu even where a site plugin re-forces the TPU
+    # platform after env parsing (the config pin wins over the plugin;
+    # the env var alone is overridden) — without this, a gateway started
+    # for CPU operation hangs in TPU client init when the tunnel is down.
+    jax.config.update("jax_platforms", "cpu")
+
 from ..config.schemas import LocalEngineConfig
 from ..models import forward_fn, init_fn, llama
 from ..models.config import ModelConfig, get_preset
@@ -163,10 +171,8 @@ class InferenceEngine:
             vocab_size=model_cfg.vocab_size)
 
         self.fault_plan: FaultPlan | None = None
-        if engine_cfg.debug_nans:
-            # The numerics sanitizer (SURVEY.md §5): compiled programs raise
-            # on NaN production instead of streaming garbage tokens.
-            jax.config.update("jax_debug_nans", True)
+        self._prev_debug_nans: bool | None = None
+        self._enable_debug_nans()
 
         self._init_params()
         self._init_state()
@@ -242,7 +248,10 @@ class InferenceEngine:
         self.samp_temperature = np.zeros((self.B,), np.float32)
         self.samp_top_p = np.ones((self.B,), np.float32)
         self.samp_top_k = np.zeros((self.B,), np.int32)
-        self._rng = jax.random.PRNGKey(int(time.time() * 1e3) % (2**31))
+        # Typed PRNG key end-to-end (the legacy raw-uint32 path is slated to
+        # become an error in future JAX); the multihost broadcast bit-casts
+        # via key_data/wrap_key_data at the wire boundary only.
+        self._rng = jax.random.key(int(time.time() * 1e3) % (2**31))
         # Device-resident mirrors for the chained decode loop; re-uploaded
         # (once) whenever host slot state changes.
         self._d_tokens = None
@@ -408,10 +417,29 @@ class InferenceEngine:
             return make_cache_attention_fn()
         return None
 
+    def _enable_debug_nans(self) -> None:
+        """The numerics sanitizer (SURVEY.md §5): compiled programs raise on
+        NaN production instead of streaming garbage tokens. The flag is
+        PROCESS-GLOBAL; the previous value is saved here and restored on
+        stop() so one engine's config doesn't tax every other program in
+        the process forever — and re-applied on start() so a restarted
+        engine keeps its sanitizer."""
+        if self.cfg.debug_nans and self._prev_debug_nans is None:
+            self._prev_debug_nans = bool(jax.config.jax_debug_nans)
+            jax.config.update("jax_debug_nans", True)
+
     # -- public API ----------------------------------------------------------
     async def start(self) -> None:
+        if self._bridge.enabled and self._bridge._shutdown_sent:
+            # Terminal in multihost mode: followers exited on SHUTDOWN, so
+            # a restarted coordinator's first publish would hang forever in
+            # the collective (advisor r1, medium).
+            raise RuntimeError(
+                "multihost engine is terminal after stop(); restart the "
+                "whole fleet to serve again")
         if self._loop_task is None:
             self._stopped = False        # restartable after stop()
+            self._enable_debug_nans()
             self._loop_task = asyncio.get_running_loop().create_task(
                 self._run_loop())
 
@@ -421,6 +449,9 @@ class InferenceEngine:
         if self._loop_task is not None:
             await self._loop_task
             self._loop_task = None
+        if self._prev_debug_nans is not None:
+            jax.config.update("jax_debug_nans", self._prev_debug_nans)
+            self._prev_debug_nans = None
         # Only after the loop has fully drained: an in-flight burst's
         # DECODE broadcast racing SHUTDOWN from another thread could reach
         # followers out of order and strand them mid-collective.
@@ -640,7 +671,8 @@ class InferenceEngine:
         tokens = state["last_token"]
         lengths = state["lengths"]
         active = state["active"]
-        key = state["key"]
+        key = jax.random.wrap_key_data(
+            jnp.asarray(state["key"], jnp.uint32))
         table = (self._device_table(),) if self.paged else ()
         if n_steps == self.decode_burst and self._decode_scan_fn is not None:
             toks, _, _, self.cache = self._decode_scan_fn(
@@ -693,7 +725,8 @@ class InferenceEngine:
             self._rng, key = jax.random.split(self._rng)
             packed = self._bridge.pack_decode_state(
                 self.lengths, self.active, self.last_token, self.samp_top_k,
-                self.samp_temperature, self.samp_top_p, np.asarray(key))
+                self.samp_temperature, self.samp_top_p,
+                np.asarray(jax.random.key_data(key)))
             self._bridge.publish_decode(n_steps, packed)
             step_tokens = self._exec_decode(
                 n_steps, self._bridge.unpack_decode_state(packed))
@@ -890,6 +923,7 @@ def _config_from_checkpoint(model_path: str) -> ModelConfig:
     cfg = json.loads((Path(model_path) / "config.json").read_text())
     mtype = cfg.get("model_type", "llama")
     common = dict(
+        rope_scaling=_parse_rope_scaling(cfg.get("rope_scaling")),
         vocab_size=cfg["vocab_size"],
         d_model=cfg["hidden_size"],
         n_layers=cfg["num_hidden_layers"],
@@ -907,3 +941,22 @@ def _config_from_checkpoint(model_path: str) -> ModelConfig:
                            experts_per_token=cfg.get("num_experts_per_tok", 2),
                            **common)
     return ModelConfig(family="llama", **common)
+
+
+def _parse_rope_scaling(block: dict | None):
+    """HF config.json ``rope_scaling`` → RopeScaling. Unsupported types
+    raise — loading a checkpoint with silently-wrong RoPE is worse than
+    refusing it. The no-op "default" type and null are both accepted."""
+    if not block:
+        return None
+    from ..models.config import RopeScaling
+    rtype = block.get("rope_type", block.get("type", "llama3"))
+    if rtype == "default":
+        return None
+    return RopeScaling(            # RopeScaling validates rtype
+        rope_type=rtype,
+        factor=float(block.get("factor", 8.0)),
+        low_freq_factor=float(block.get("low_freq_factor", 1.0)),
+        high_freq_factor=float(block.get("high_freq_factor", 4.0)),
+        original_max_seq=int(block.get("original_max_position_embeddings",
+                                       8192)))

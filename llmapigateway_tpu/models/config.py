@@ -10,6 +10,29 @@ from dataclasses import dataclass, field
 
 
 @dataclass(frozen=True)
+class RopeScaling:
+    """RoPE frequency scaling (HF ``rope_scaling`` block).
+
+    ``llama3`` — Llama-3.1-style per-frequency-band scaling (long
+    wavelengths divided by ``factor``, short ones untouched, smooth
+    interpolation between ``low_freq_factor``/``high_freq_factor`` bands of
+    the ``original_max_seq`` context). ``linear`` — uniform position
+    interpolation (every frequency divided by ``factor``).
+    """
+    rope_type: str = "llama3"      # "llama3" | "linear"
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_seq: int = 8192
+
+    def __post_init__(self):
+        if self.rope_type not in ("llama3", "linear"):
+            raise ValueError(
+                f"unsupported rope_scaling type {self.rope_type!r}; "
+                f"supported: llama3, linear")
+
+
+@dataclass(frozen=True)
 class ModelConfig:
     family: str = "llama"          # "llama" | "mixtral"
     vocab_size: int = 32000
@@ -19,6 +42,7 @@ class ModelConfig:
     n_kv_heads: int = 4
     d_ff: int = 5632
     rope_theta: float = 10000.0
+    rope_scaling: RopeScaling | None = None
     rms_eps: float = 1e-5
     max_seq_len: int = 4096
     tie_embeddings: bool = False

@@ -88,13 +88,36 @@ def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
     return (normed * weight.astype(jnp.float32)).astype(x.dtype)
 
 
-def rope_tables(positions: jax.Array, head_dim: int,
-                theta: float) -> tuple[jax.Array, jax.Array]:
-    """cos/sin tables [..., head_dim/2] (fp32) for given absolute positions."""
+def rope_tables(positions: jax.Array, head_dim: int, theta: float,
+                scaling=None) -> tuple[jax.Array, jax.Array]:
+    """cos/sin tables [..., head_dim/2] (fp32) for given absolute positions.
+    ``scaling`` is an optional ``config.RopeScaling`` — without it a modern
+    Llama-3.1-style checkpoint would silently load with wrong RoPE."""
     half = head_dim // 2
     freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) / half))
+    if scaling is not None:
+        freqs = _scale_rope_freqs(freqs, scaling)
     angles = positions.astype(jnp.float32)[..., None] * freqs   # [..., half]
     return jnp.cos(angles), jnp.sin(angles)
+
+
+def _scale_rope_freqs(freqs: jax.Array, scaling) -> jax.Array:
+    """Apply HF-convention rope_scaling to the inverse-frequency vector
+    (matches transformers' _compute_llama3_parameters numerics)."""
+    if scaling.rope_type == "linear":
+        return freqs / scaling.factor
+    # llama3: long wavelengths (beyond the original context's low-freq band)
+    # are slowed by `factor`; short ones kept; the middle band interpolates.
+    old_ctx = float(scaling.original_max_seq)
+    low_wavelen = old_ctx / scaling.low_freq_factor
+    high_wavelen = old_ctx / scaling.high_freq_factor
+    wavelen = 2.0 * jnp.pi / freqs
+    scaled = jnp.where(wavelen > low_wavelen, freqs / scaling.factor, freqs)
+    smooth = (old_ctx / wavelen - scaling.low_freq_factor) / (
+        scaling.high_freq_factor - scaling.low_freq_factor)
+    smoothed = (1.0 - smooth) * freqs / scaling.factor + smooth * freqs
+    is_medium = (wavelen <= low_wavelen) & (wavelen >= high_wavelen)
+    return jnp.where(is_medium, smoothed, scaled)
 
 
 def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
@@ -240,7 +263,7 @@ def forward(params: Params, config: ModelConfig, tokens: jax.Array,
     x = jnp.take(params["embed"], tokens, axis=0)   # [B, T, D]
 
     positions = lengths[:, None] + jnp.arange(T)[None, :]       # [B, T]
-    cos, sin = rope_tables(positions, dh, c.rope_theta)
+    cos, sin = rope_tables(positions, dh, c.rope_theta, c.rope_scaling)
 
     layer_params = params["layers"]
     custom_mlp = mlp_fn

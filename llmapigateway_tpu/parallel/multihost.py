@@ -126,12 +126,23 @@ class HostBridge:
         return cmd
 
     # -- coordinator side -----------------------------------------------------
+    def _check_live(self) -> None:
+        """After SHUTDOWN the followers have exited their replay loop: any
+        further broadcast would block forever inside the collective (1 of N
+        participants), hanging the worker thread with no error. The bridge
+        is therefore TERMINAL once shut down — fail loudly instead."""
+        if self._shutdown_sent:
+            raise RuntimeError(
+                "multihost bridge is shut down; the engine cannot be "
+                "restarted in multihost mode (followers already exited)")
+
     def publish_prefill(self, slot: int, pos: int,
                         tokens: np.ndarray) -> None:
         """The compile bucket is NOT on the wire: every process derives it
         from (pos, len(tokens)) + engine config, so it cannot diverge."""
         if not self.enabled:
             return
+        self._check_live()
         self._broadcast(self._frame(OP_PREFILL, slot, pos,
                                     payload=tokens.astype(np.int32)))
 
@@ -163,6 +174,7 @@ class HostBridge:
     def publish_decode(self, n_steps: int, state: np.ndarray) -> None:
         if not self.enabled:
             return
+        self._check_live()
         self._broadcast(self._frame(OP_DECODE, n_steps, payload=state))
 
     def publish_shutdown(self) -> None:

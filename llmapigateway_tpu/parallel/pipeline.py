@@ -112,7 +112,7 @@ def _build_run(c: ModelConfig, mesh: Mesh, n_stages: int, M: int, Bm: int,
         x_all = jnp.take(params["embed"], tokens, axis=0).reshape(M, Bm, T, -1)
         positions = (lengths[:, None] + jnp.arange(T)[None, :])     # [B, T]
         cos_all, sin_all = llama.rope_tables(positions, c.head_dim,
-                                             c.rope_theta)
+                                             c.rope_theta, c.rope_scaling)
         cos_all = cos_all.reshape(M, Bm, T, -1)
         sin_all = sin_all.reshape(M, Bm, T, -1)
         len_all = lengths.reshape(M, Bm)

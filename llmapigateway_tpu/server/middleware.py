@@ -33,7 +33,11 @@ def cors_middleware(allowed_origins: list[str]):
     @web.middleware
     async def middleware(request: web.Request, handler):
         origin = request.headers.get("Origin")
-        if request.method == "OPTIONS":
+        preflight = (request.method == "OPTIONS" and origin
+                     and "Access-Control-Request-Method" in request.headers)
+        if preflight:
+            # Only genuine CORS preflights short-circuit routing; a plain
+            # OPTIONS to an unknown route still 404s through the router.
             resp = web.Response(status=204)
         else:
             resp = await handler(request)
@@ -41,9 +45,39 @@ def cors_middleware(allowed_origins: list[str]):
             resp.headers["Access-Control-Allow-Origin"] = "*" if allow_all else origin
             resp.headers["Access-Control-Allow-Methods"] = "GET, POST, OPTIONS"
             resp.headers["Access-Control-Allow-Headers"] = "Authorization, Content-Type"
+        if not allow_all:
+            # EVERY response varies by requester origin (including ones to
+            # no-Origin or disallowed-Origin requests — a cache storing
+            # those unkeyed would serve them, CORS-headerless, to allowed
+            # origins). Append, never clobber a handler's own Vary.
+            vary = resp.headers.get("Vary")
+            if vary is None:
+                resp.headers["Vary"] = "Origin"
+            elif "origin" not in vary.lower():
+                resp.headers["Vary"] = vary + ", Origin"
         return resp
 
     return middleware
+
+
+def _redacted_payload(raw: bytes) -> dict | None:
+    """Parse a chat-completions POST body and redact message/tool contents —
+    the reference logs payloads this way (request_logging.py:49-61): shape
+    and params are diagnostic, contents are private."""
+    import json
+    try:
+        payload = json.loads(raw)
+    except Exception:
+        return None
+    if not isinstance(payload, dict):
+        return None
+    for key in ("messages", "tools"):
+        val = payload.get(key)
+        if isinstance(val, list):
+            payload[key] = f"<redacted: {len(val)} {key}>"
+        elif val is not None:
+            payload[key] = "<redacted>"
+    return payload
 
 
 def request_logging_middleware():
@@ -54,10 +88,17 @@ def request_logging_middleware():
         req_id = uuid.uuid4().hex[:16]
         request["request_id"] = req_id
         start = time.monotonic()
-        logger.info("request start", extra={
+        log_extra = {
             "request_id": req_id, "method": request.method,
             "path": request.path, "client": request.remote,
-            "headers": mask_headers(dict(request.headers))})
+            "headers": mask_headers(dict(request.headers))}
+        if (request.method == "POST"
+                and request.path.endswith("/chat/completions")):
+            # aiohttp caches the body, so the handler can re-read it.
+            payload = _redacted_payload(await request.read())
+            if payload is not None:
+                log_extra["payload"] = payload
+        logger.info("request start", extra=log_extra)
         try:
             resp = await handler(request)
             status = resp.status

@@ -49,6 +49,19 @@ def _shared_loop():
     return _loop
 
 
+@pytest.fixture(scope="session")
+def stop_engine():
+    """Fixture-teardown helper: stop an engine ON THE SHARED LOOP so its
+    batching-loop task is awaited (not garbage-collected mid-flight —
+    'Task was destroyed but it is pending'). A fixture, not an importable
+    function: pytest loads conftest under its own module name, so a
+    ``from tests.conftest import ...`` in a test would get a SECOND module
+    instance with a second (wrong) loop."""
+    def _stop(eng):
+        _shared_loop().run_until_complete(eng.stop())
+    return _stop
+
+
 def pytest_pyfunc_call(pyfuncitem):
     """Run ``async def`` tests on the shared loop (no pytest-asyncio here)."""
     func = pyfuncitem.obj

@@ -94,3 +94,56 @@ def test_config_mismatch_detected(hf_checkpoint):
                       n_kv_heads=2, d_ff=128)
     with pytest.raises(ValueError, match="mismatch"):
         load_checkpoint(path, bad, dtype=jnp.float32)
+
+
+def test_rope_scaling_logit_parity(tmp_path):
+    """Llama-3.1-style rope_scaling: our forward must match HF torch logits
+    when the checkpoint carries a llama3 rope_scaling block (VERDICT r1
+    item 8 — previously ignored, silently wrong RoPE)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from llmapigateway_tpu.engine.engine import _config_from_checkpoint
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False,
+        rope_scaling={"rope_type": "llama3", "factor": 8.0,
+                      "low_freq_factor": 1.0, "high_freq_factor": 4.0,
+                      "original_max_position_embeddings": 64})
+    torch.manual_seed(1)
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    model.eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    cfg = _config_from_checkpoint(tmp_path)
+    assert cfg.rope_scaling is not None
+    assert cfg.rope_scaling.rope_type == "llama3"
+    assert cfg.rope_scaling.original_max_seq == 64
+
+    params = load_checkpoint(tmp_path, cfg, dtype=jnp.float32)
+    ids = np.array([[5, 17, 99, 3, 42, 7, 81, 2]], dtype=np.int32)
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    cache = llama.KVCache.create(cfg, 1, 32, dtype=jnp.float32)
+    logits, _ = llama.forward(params, cfg, jnp.asarray(ids),
+                              jnp.zeros((1,), jnp.int32), cache)
+    np.testing.assert_allclose(np.asarray(logits), hf_logits,
+                               rtol=2e-3, atol=2e-3)
+    # And the scaling must actually matter: the rotated tables diverge from
+    # the unscaled ones at long-context positions (low-frequency band).
+    pos = jnp.asarray([[200.0]])
+    cos_s, _ = llama.rope_tables(pos, cfg.head_dim, cfg.rope_theta,
+                                 cfg.rope_scaling)
+    cos_u, _ = llama.rope_tables(pos, cfg.head_dim, cfg.rope_theta)
+    assert float(np.max(np.abs(np.asarray(cos_s) - np.asarray(cos_u)))) > 0.1
+
+
+def test_rope_scaling_unsupported_type_rejected(tmp_path):
+    from llmapigateway_tpu.engine.engine import _parse_rope_scaling
+    assert _parse_rope_scaling(None) is None
+    assert _parse_rope_scaling({"rope_type": "default"}) is None
+    assert _parse_rope_scaling({"type": "linear", "factor": 2.0}).factor == 2.0
+    with pytest.raises(ValueError, match="unsupported rope_scaling"):
+        _parse_rope_scaling({"rope_type": "yarn", "factor": 4.0})

@@ -12,12 +12,13 @@ import jax
 
 
 @pytest.fixture(scope="module")
-def engine():
+def engine(stop_engine):
     cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=4,
                             max_seq_len=128, prefill_chunk=32,
                             dtype="float32")
     eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
     yield eng
+    stop_engine(eng)
 
 
 async def _generate(eng, prompt="hello", max_tokens=8, **kw) -> GenRequest:

@@ -20,8 +20,10 @@ def _mk_engine(**kw):
 
 
 @pytest.fixture(scope="module")
-def paged_engine():
-    yield _mk_engine()
+def paged_engine(stop_engine):
+    eng = _mk_engine()
+    yield eng
+    stop_engine(eng)
 
 
 async def _generate(eng, prompt="hello", max_tokens=8, **kw) -> GenRequest:

@@ -11,10 +11,12 @@ from llmapigateway_tpu.engine.engine import FaultPlan, GenRequest, InferenceEngi
 
 
 @pytest.fixture(scope="module")
-def engine():
+def engine(stop_engine):
     cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
                             max_seq_len=64, prefill_chunk=8, decode_burst=2)
-    return InferenceEngine(cfg)
+    eng = InferenceEngine(cfg)
+    yield eng
+    stop_engine(eng)
 
 
 async def _run(engine, prompt_ids, max_tokens=6):

@@ -121,3 +121,38 @@ def test_inactive_rows_not_written(setup):
 def test_gqa_head_counts(setup):
     cfg, _ = setup
     assert cfg.n_heads % cfg.n_kv_heads == 0
+
+
+def test_insert_kv_invariant_tail_garbage_masked_by_lengths():
+    """Pin the insert_kv inactive-row contract (advisor r1): inactive rows'
+    writes are routed to the row TAIL (offset clamped to S-T) instead of a
+    full-cache masked no-op. INVARIANT: cache contents at positions >=
+    lengths[b] are UNDEFINED — any future export/snapshot/prefix-cache
+    path must mask to `lengths` before use. This test documents both
+    halves: live positions are preserved, and the tail really is dirtied.
+    """
+    B, KV, S, Dh, T = 2, 2, 16, 4, 2
+    layer_k = jnp.arange(B * KV * S * Dh, dtype=jnp.float32).reshape(
+        B, KV, S, Dh)
+    layer_v = layer_k + 1000.0
+    k_new = jnp.full((B, T, KV, Dh), -7.0)
+    v_new = jnp.full((B, T, KV, Dh), -9.0)
+    lengths = jnp.asarray([4, 4], jnp.int32)
+    active = jnp.asarray([True, False])
+
+    out_k, out_v = llama.insert_kv(layer_k, layer_v, k_new, v_new,
+                                   lengths, active)
+    out_k, out_v = np.asarray(out_k), np.asarray(out_v)
+    ref_k = np.asarray(layer_k)
+
+    # Active row: new tokens land at [lengths, lengths+T), rest preserved.
+    assert (out_k[0, :, 4:6] == -7.0).all()
+    np.testing.assert_array_equal(out_k[0, :, :4], ref_k[0, :, :4])
+    np.testing.assert_array_equal(out_k[0, :, 6:], ref_k[0, :, 6:])
+
+    # Inactive row: every position < its length is untouched...
+    np.testing.assert_array_equal(out_k[1, :, :4], ref_k[1, :, :4])
+    np.testing.assert_array_equal(out_v[1, :, :4],
+                                  np.asarray(layer_v)[1, :, :4])
+    # ...but the row tail [S-T, S) is dirtied — the documented garbage zone.
+    assert (out_k[1, :, S - T:] == -7.0).all()

@@ -6,6 +6,7 @@ driving the real async engine over a TP=4 mesh that SPANS both processes
 follower replaying the broadcast command stream (parallel/multihost.py).
 Each worker asserts the decode tokens matched bit-for-bit."""
 import os
+import socket
 import subprocess
 import sys
 from pathlib import Path
@@ -13,12 +14,20 @@ from pathlib import Path
 ROOT = Path(__file__).resolve().parent.parent
 
 
+def _free_port() -> str:
+    """Ephemeral rendezvous port — a hard-coded one collides when two CI
+    jobs or xdist workers share a host (advisor r1)."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return str(s.getsockname()[1])
+
+
 def test_two_process_lockstep_serving():
     env = {**os.environ,
            "JAX_PLATFORMS": "cpu",
            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
            "PYTHONPATH": str(ROOT)}
-    port = "12637"
+    port = _free_port()
     procs = [subprocess.Popen(
         [sys.executable, str(ROOT / "tests" / "multihost_worker.py"),
          str(i), "2", port],
@@ -38,3 +47,35 @@ def test_two_process_lockstep_serving():
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
         assert "MULTIHOST_OK" in out, f"worker {i} no marker:\n{out}"
+
+
+def test_bridge_terminal_after_shutdown():
+    """After SHUTDOWN the followers are gone: any further publish must fail
+    loudly instead of hanging forever inside the collective (advisor r1)."""
+    import numpy as np
+    import pytest
+    from llmapigateway_tpu.parallel.multihost import HostBridge
+
+    b = HostBridge(2, 8)
+    b.enabled = True            # simulate multihost without 2 processes
+    b._shutdown_sent = True
+    with pytest.raises(RuntimeError, match="shut down"):
+        b.publish_decode(1, np.zeros((14,), np.int32))
+    with pytest.raises(RuntimeError, match="shut down"):
+        b.publish_prefill(0, 0, np.zeros((4,), np.int32))
+
+
+async def test_engine_start_terminal_after_multihost_shutdown():
+    import pytest
+    from llmapigateway_tpu.config.schemas import LocalEngineConfig
+    from llmapigateway_tpu.engine.engine import InferenceEngine
+    import jax
+
+    eng = InferenceEngine(
+        LocalEngineConfig(preset="tiny-test", max_batch_size=1,
+                          max_seq_len=64, prefill_chunk=16, dtype="float32"),
+        devices=[jax.devices("cpu")[0]])
+    eng._bridge.enabled = True
+    eng._bridge._shutdown_sent = True
+    with pytest.raises(RuntimeError, match="terminal"):
+        await eng.start()

@@ -272,3 +272,62 @@ async def test_engine_stats_and_trace_capture(tmp_path):
 
         resp = await g.client.post("/v1/api/profiler/trace?duration_ms=nope")
         assert resp.status == 400
+
+
+async def test_request_payload_logged_redacted(tmp_path, caplog):
+    """Chat POST payloads are logged with messages/tools redacted
+    (reference parity: request_logging.py:49-61) — params visible,
+    contents never."""
+    import logging
+    secret = "my-private-prompt-text-42"
+    with caplog.at_level(logging.INFO, logger="gateway.request"):
+        async with Gateway(tmp_path) as g:
+            resp = await g.client.post("/v1/chat/completions", json={
+                "model": "gw/chat", "temperature": 0.5,
+                "messages": [{"role": "user", "content": secret}],
+                "tools": [{"type": "function", "function": {"name": secret}}]})
+            assert resp.status == 200
+    payloads = [r.payload for r in caplog.records if hasattr(r, "payload")]
+    assert payloads, "chat POST produced no payload log"
+    p = payloads[0]
+    assert p["model"] == "gw/chat" and p["temperature"] == 0.5
+    assert p["messages"] == "<redacted: 1 messages>"
+    assert p["tools"] == "<redacted: 1 tools>"
+    assert secret not in caplog.text
+
+
+async def test_cors_preflight_and_vary(tmp_path):
+    async with Gateway(tmp_path) as g:
+        # Genuine preflight short-circuits with 204 even on protected routes.
+        resp = await g.client.options("/v1/chat/completions", headers={
+            "Origin": "http://a.example",
+            "Access-Control-Request-Method": "POST"})
+        assert resp.status == 204
+        assert resp.headers["Access-Control-Allow-Origin"] == "*"
+        # A plain OPTIONS (no preflight headers) routes normally -> 405/404,
+        # not a blanket 204.
+        resp = await g.client.options("/v1/chat/completions")
+        assert resp.status in (404, 405)
+
+
+async def test_cors_specific_origin_sets_vary():
+    from aiohttp import web
+    from llmapigateway_tpu.server.middleware import cors_middleware
+
+    app = web.Application(middlewares=[cors_middleware(["http://a.example"])])
+    app.router.add_get("/x", lambda r: web.json_response({}))
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        resp = await client.get("/x", headers={"Origin": "http://a.example"})
+        assert resp.headers["Access-Control-Allow-Origin"] == "http://a.example"
+        assert resp.headers["Vary"] == "Origin"
+        resp = await client.get("/x", headers={"Origin": "http://evil.example"})
+        assert "Access-Control-Allow-Origin" not in resp.headers
+        # Vary must be on EVERY response when origins are restricted, or a
+        # shared cache could serve a CORS-headerless copy to allowed origins.
+        assert resp.headers["Vary"] == "Origin"
+        resp = await client.get("/x")
+        assert resp.headers["Vary"] == "Origin"
+    finally:
+        await client.close()

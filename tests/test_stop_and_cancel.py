@@ -11,11 +11,13 @@ from llmapigateway_tpu.engine.tokenizer import ByteTokenizer, IncrementalDetoken
 
 
 @pytest.fixture(scope="module")
-def engine():
+def engine(stop_engine):
     cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
                             max_seq_len=128, prefill_chunk=32,
                             dtype="float32", decode_burst=4)
-    return InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
+    eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
+    yield eng
+    stop_engine(eng)
 
 
 def _run_emission(engine, token_texts, stop, max_tokens=50):
