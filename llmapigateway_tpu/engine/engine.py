@@ -154,12 +154,14 @@ class InferenceEngine:
 
         # Multi-host: process 0 runs the scheduler and publishes every
         # compiled-program call; followers replay (parallel/multihost.py).
+        # Paged layout: the page table rides the command stream (followers
+        # have no allocator), sized here so the wire width is fixed.
         from ..parallel.multihost import HostBridge
-        self._bridge = HostBridge(self.B, self.prefill_chunk)
-        if self._bridge.enabled and self.paged:
-            raise ValueError(
-                "multihost serving currently requires kv_layout=contiguous "
-                "(the page table is not yet broadcast to followers)")
+        page = self.cfg.kv_page_size
+        self._bridge = HostBridge(
+            self.B, self.prefill_chunk,
+            table_slots=(self.S + page - 1) // page if self.paged else 0)
+        self._published_table: np.ndarray | None = None
         if self.mesh.shape.get("pipe", 1) > 1:
             raise ValueError(
                 "the serving engine shards DP/TP/EP; pipeline stages are "
@@ -619,7 +621,8 @@ class InferenceEngine:
         chunk = np.asarray(ids[pos:pos + self.prefill_chunk], np.int32)
         if self.fault_plan:
             self.fault_plan.on_prefill()
-        self._bridge.publish_prefill(slot, pos, chunk)
+        self._bridge.publish_prefill(slot, pos, chunk,
+                                     table=self._table_to_publish())
         row, self.cache = self._exec_prefill(slot, pos, chunk)
         req.prefill_pos = pos + len(chunk)
         if req.prefill_pos < len(ids):
@@ -697,11 +700,33 @@ class InferenceEngine:
             pending.append(tokens)
         return [np.asarray(t) for t in pending]
 
-    def _follow_prefill(self, slot: int, pos: int,
-                        chunk: np.ndarray) -> None:
+    def _table_to_publish(self) -> np.ndarray | None:
+        """Coordinator side: the page table, but only when it changed since
+        the last publish (admission/release mutate it between compiled
+        calls; followers apply it before executing the op)."""
+        if not (self.paged and self._bridge.enabled):
+            return None
+        if (self._published_table is not None
+                and np.array_equal(self.allocator.table,
+                                   self._published_table)):
+            return None
+        self._published_table = self.allocator.table.copy()
+        return self._published_table
+
+    def _apply_table(self, table: np.ndarray | None) -> None:
+        """Follower side: adopt the broadcast page table as local truth."""
+        if table is not None:
+            self.allocator.table[:, :] = table
+            self._table_dirty = True
+
+    def _follow_prefill(self, slot: int, pos: int, chunk: np.ndarray,
+                        table: np.ndarray | None = None) -> None:
+        self._apply_table(table)
         _, self.cache = self._exec_prefill(slot, pos, chunk)
 
-    def _follow_decode(self, n_steps: int, state: dict) -> None:
+    def _follow_decode(self, n_steps: int, state: dict,
+                       table: np.ndarray | None = None) -> None:
+        self._apply_table(table)
         self._exec_decode(n_steps, state)
 
     def run_follower(self) -> None:
@@ -727,7 +752,8 @@ class InferenceEngine:
                 self.lengths, self.active, self.last_token, self.samp_top_k,
                 self.samp_temperature, self.samp_top_p,
                 np.asarray(jax.random.key_data(key)))
-            self._bridge.publish_decode(n_steps, packed)
+            self._bridge.publish_decode(n_steps, packed,
+                                        table=self._table_to_publish())
             step_tokens = self._exec_decode(
                 n_steps, self._bridge.unpack_decode_state(packed))
             self.lengths[self.active] += n_steps

@@ -15,7 +15,7 @@ would carry (the reference's only transport is outbound HTTPS —
 Wire format: ONE int32 vector per command, shape ``[HEADER + payload]``
 (fixed at bridge construction so the collective's shape never changes):
 
-  ``[opcode, a, b, c, n_payload, payload ...]``
+  ``[opcode, a, b, has_table, n_payload, _, _, _, payload ..., table tail]``
 
   * SHUTDOWN:       opcode 0
   * PREFILL_CHUNK:  opcode 1, a=slot, b=pos, payload=token ids (the
@@ -25,6 +25,11 @@ Wire format: ONE int32 vector per command, shape ``[HEADER + payload]``
     temperature[B], top_p[B] (float32 bit-cast) then rng key (uint32
     bit-cast) — everything a follower needs to build bit-identical
     decode inputs.
+  * ``cmd[3]`` is RESERVED as the has-table flag: when 1, the LAST
+    ``B * table_slots`` ints of the frame carry the paged-KV page table
+    (followers have no allocator; table changes ride the same stream
+    that orders every compiled call). The tail region is reserved on
+    top of the payload capacity, so payload and table never overlap.
 
 Array placement: in multi-process mode ``jax.device_put`` cannot target a
 sharding spanning non-addressable devices; :func:`put_global` switches to
@@ -95,13 +100,22 @@ class HostBridge:
     attribute check.
     """
 
-    def __init__(self, batch_size: int, prefill_bucket_max: int):
+    def __init__(self, batch_size: int, prefill_bucket_max: int,
+                 table_slots: int = 0):
         self.enabled = is_multihost()
         self._shutdown_sent = False
         self.B = batch_size
+        # Paged KV: the [B, table_slots] page table rides at the TAIL of any
+        # command whose frame sets the has-table flag (cmd[3]) — followers
+        # have no allocator, so table changes reach them in the same stream
+        # that orders every compiled call (VERDICT r1 item 5).
+        self.table_size = batch_size * table_slots
+        self.table_slots = table_slots
         # Payload must fit the larger of: a prefill chunk's token ids, or
-        # the packed decode state (4 int + 2 float vectors of B, + 2 key).
-        self.payload = max(prefill_bucket_max, 6 * batch_size + 2)
+        # the packed decode state (4 int + 2 float vectors of B, + 2 key),
+        # plus the page table tail.
+        self.payload = max(prefill_bucket_max,
+                           6 * batch_size + 2) + self.table_size
         self.width = HEADER + self.payload
         if self.enabled:
             logger.info(
@@ -116,14 +130,25 @@ class HostBridge:
         assert cmd.shape == (self.width,)
         return np.asarray(multihost_utils.broadcast_one_to_all(cmd))
 
-    def _frame(self, opcode: int, a: int = 0, b: int = 0, c: int = 0,
-               payload: np.ndarray | None = None) -> np.ndarray:
+    def _frame(self, opcode: int, a: int = 0, b: int = 0,
+               payload: np.ndarray | None = None,
+               table: np.ndarray | None = None) -> np.ndarray:
         cmd = np.zeros((self.width,), np.int32)
-        cmd[0], cmd[1], cmd[2], cmd[3] = opcode, a, b, c
+        cmd[0], cmd[1], cmd[2] = opcode, a, b
         if payload is not None:
             cmd[4] = len(payload)
             cmd[HEADER:HEADER + len(payload)] = payload
+        if table is not None:
+            assert table.size == self.table_size
+            cmd[3] = 1                               # has-table flag
+            cmd[self.width - self.table_size:] = table.ravel()
         return cmd
+
+    def _parse_table(self, cmd: np.ndarray) -> np.ndarray | None:
+        if not cmd[3]:
+            return None
+        return (cmd[self.width - self.table_size:]
+                .reshape(self.B, self.table_slots).copy())
 
     # -- coordinator side -----------------------------------------------------
     def _check_live(self) -> None:
@@ -136,15 +161,16 @@ class HostBridge:
                 "multihost bridge is shut down; the engine cannot be "
                 "restarted in multihost mode (followers already exited)")
 
-    def publish_prefill(self, slot: int, pos: int,
-                        tokens: np.ndarray) -> None:
+    def publish_prefill(self, slot: int, pos: int, tokens: np.ndarray,
+                        table: np.ndarray | None = None) -> None:
         """The compile bucket is NOT on the wire: every process derives it
         from (pos, len(tokens)) + engine config, so it cannot diverge."""
         if not self.enabled:
             return
         self._check_live()
         self._broadcast(self._frame(OP_PREFILL, slot, pos,
-                                    payload=tokens.astype(np.int32)))
+                                    payload=tokens.astype(np.int32),
+                                    table=table))
 
     def pack_decode_state(self, lengths, active, last_token, top_k,
                           temperature, top_p, key) -> np.ndarray:
@@ -171,11 +197,13 @@ class HostBridge:
             key=payload[6 * B:6 * B + 2].view(np.uint32).copy(),
         )
 
-    def publish_decode(self, n_steps: int, state: np.ndarray) -> None:
+    def publish_decode(self, n_steps: int, state: np.ndarray,
+                       table: np.ndarray | None = None) -> None:
         if not self.enabled:
             return
         self._check_live()
-        self._broadcast(self._frame(OP_DECODE, n_steps, payload=state))
+        self._broadcast(self._frame(OP_DECODE, n_steps, payload=state,
+                                    table=table))
 
     def publish_shutdown(self) -> None:
         """Idempotent: a second broadcast after followers have exited their
@@ -186,11 +214,12 @@ class HostBridge:
         self._broadcast(self._frame(OP_SHUTDOWN))
 
     # -- follower side --------------------------------------------------------
-    def follow(self, on_prefill: Callable[[int, int, np.ndarray], None],
-               on_decode: Callable[[int, dict], None]) -> None:
+    def follow(self, on_prefill: Callable[..., None],
+               on_decode: Callable[..., None]) -> None:
         """Blocking replay loop for follower processes (process_index > 0):
         receive one command, execute the same compiled call, repeat until
-        SHUTDOWN."""
+        SHUTDOWN. Callbacks receive the attached page table (or None) as
+        their last argument."""
         assert self.enabled and not is_coordinator()
         logger.info("follower %d: entering replay loop", jax.process_index())
         while True:
@@ -201,9 +230,11 @@ class HostBridge:
                 return
             n = int(cmd[4])
             payload = cmd[HEADER:HEADER + n]
+            table = self._parse_table(cmd)
             if op == OP_PREFILL:
-                on_prefill(int(cmd[1]), int(cmd[2]), payload)
+                on_prefill(int(cmd[1]), int(cmd[2]), payload, table)
             elif op == OP_DECODE:
-                on_decode(int(cmd[1]), self.unpack_decode_state(payload))
+                on_decode(int(cmd[1]), self.unpack_decode_state(payload),
+                          table)
             else:
                 raise RuntimeError(f"unknown multihost opcode {op}")

@@ -10,10 +10,20 @@ bf16 default matmul precision, which breaks fp32 numerics comparisons).
 """
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# TPU_SMOKE=1 escapes the CPU pin so the on-TPU compiled-kernel smoke tests
+# can see the real chip. Use it ONLY with that one module:
+#   TPU_SMOKE=1 python -m pytest tests/test_tpu_compiled.py
+# It disables the pin for the whole pytest session, so running the full
+# suite under it would put every test on the TPU (bf16 matmul defaults
+# break fp32 numerics tests) and drop the 8 virtual CPU devices the mesh
+# tests need. Without TPU_SMOKE, everything runs on the 8-CPU mesh.
+_TPU_SMOKE = os.environ.get("TPU_SMOKE") == "1"
+
+if not _TPU_SMOKE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
 import jax  # noqa: E402
 
@@ -22,7 +32,8 @@ import jax  # noqa: E402
 # lazily, so overriding the *config* back to cpu before any jax.devices()
 # call keeps the test process entirely off the TPU (and immune to tunnel
 # outages).
-jax.config.update("jax_platforms", "cpu")
+if not _TPU_SMOKE:
+    jax.config.update("jax_platforms", "cpu")
 
 
 def cpu_devices():

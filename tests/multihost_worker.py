@@ -14,6 +14,7 @@ import sys
 PROC_ID = int(sys.argv[1])
 N_PROC = int(sys.argv[2])
 PORT = sys.argv[3]
+KV_LAYOUT = sys.argv[4] if len(sys.argv) > 4 else "contiguous"
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
@@ -36,7 +37,8 @@ MAX_REC = 64
 
 cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2, max_seq_len=64,
                         prefill_chunk=8, decode_burst=4,
-                        mesh={"model": 4}, attention="reference")
+                        mesh={"model": 4}, attention="reference",
+                        kv_layout=KV_LAYOUT, kv_page_size=16)
 engine = InferenceEngine(cfg)
 assert engine._bridge.enabled, "bridge must be active with 2 processes"
 

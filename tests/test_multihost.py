@@ -22,7 +22,11 @@ def _free_port() -> str:
         return str(s.getsockname()[1])
 
 
-def test_two_process_lockstep_serving():
+import pytest
+
+
+@pytest.mark.parametrize("kv_layout", ["contiguous", "paged"])
+def test_two_process_lockstep_serving(kv_layout):
     env = {**os.environ,
            "JAX_PLATFORMS": "cpu",
            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
@@ -30,7 +34,7 @@ def test_two_process_lockstep_serving():
     port = _free_port()
     procs = [subprocess.Popen(
         [sys.executable, str(ROOT / "tests" / "multihost_worker.py"),
-         str(i), "2", port],
+         str(i), "2", port, kv_layout],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True) for i in range(2)]
     outs = []

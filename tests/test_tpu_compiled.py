@@ -1,0 +1,108 @@
+"""On-TPU compiled (Mosaic) smoke for the Pallas kernels.
+
+Every numerics test elsewhere runs the kernels in interpret mode on CPU;
+index-map tricks like the decode kernel's DMA-elision clamp
+(ops/flash_attention.py kv_index) behave differently under real Mosaic
+lowering, so until a compiled run passes, "Pallas kernels" is a claim, not
+a fact (VERDICT r1 item 2). Run with:
+
+    TPU_SMOKE=1 python -m pytest tests/test_tpu_compiled.py -q
+
+(TPU_SMOKE=1 stops conftest from pinning the process to CPU; without it —
+or without a reachable TPU — every test here skips.)
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from llmapigateway_tpu.models.llama import dense_cache_attention
+from llmapigateway_tpu.ops import make_cache_attention_fn
+from llmapigateway_tpu.ops.paged_attention import make_paged_attention_fn
+
+pytestmark = pytest.mark.skipif(
+    jax.default_backend() != "tpu",
+    reason="compiled-Mosaic smoke needs a real TPU (set TPU_SMOKE=1)")
+
+# fp32 inputs; on TPU the MXU contracts with bf16-rounded passes, so the
+# compiled kernel and the jnp reference can legitimately differ by ~1e-2.
+TOL = dict(rtol=2e-2, atol=2e-2)
+
+
+def _mk(B, S, T, H, KV, Dh, seed=0):
+    keys = jax.random.split(jax.random.PRNGKey(seed), 5)
+    q = jax.random.normal(keys[0], (B, T, H, Dh), jnp.float32)
+    k_new = jax.random.normal(keys[1], (B, T, KV, Dh), jnp.float32)
+    v_new = jax.random.normal(keys[2], (B, T, KV, Dh), jnp.float32)
+    layer_k = jax.random.normal(keys[3], (B, KV, S, Dh), jnp.float32)
+    layer_v = jax.random.normal(keys[4], (B, KV, S, Dh), jnp.float32)
+    return q, k_new, v_new, layer_k, layer_v
+
+
+def test_flash_decode_compiled_matches_reference():
+    B, S, H, KV, Dh = 4, 512, 32, 4, 64
+    q, k_new, v_new, layer_k, layer_v = _mk(B, S, 1, H, KV, Dh)
+    lengths = jnp.asarray([5, 100, 250, 511 - 1], jnp.int32)
+    ref, ref_k, ref_v = dense_cache_attention(
+        q, k_new, v_new, layer_k, layer_v, lengths)
+    attn = jax.jit(make_cache_attention_fn(interpret=False))
+    got, got_k, got_v = attn(q, k_new, v_new, layer_k, layer_v, lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), **TOL)
+    np.testing.assert_allclose(np.asarray(got_k), np.asarray(ref_k), **TOL)
+
+
+def test_flash_prefill_compiled_matches_reference():
+    B, S, T, H, KV, Dh = 2, 512, 128, 8, 4, 128
+    q, k_new, v_new, layer_k, layer_v = _mk(B, S, T, H, KV, Dh, seed=1)
+    start = jnp.asarray([0, 200], jnp.int32)
+    ref, ref_k, ref_v = dense_cache_attention(
+        q, k_new, v_new, layer_k, layer_v, start)
+    attn = jax.jit(make_cache_attention_fn(interpret=False))
+    got, got_k, got_v = attn(q, k_new, v_new, layer_k, layer_v, start)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), **TOL)
+    np.testing.assert_allclose(np.asarray(got_k), np.asarray(ref_k), **TOL)
+
+
+def _paged_setup(B, S, T, H, KV, Dh, page, seed=0):
+    """Scrambled page table + pool mirroring a dense cache (same layout the
+    interpret-mode tests in test_ops_paged.py cross-check)."""
+    NP = S // page
+    P = B * NP + 1 + 3
+    rng = np.random.default_rng(seed)
+    phys = np.arange(1, B * NP + 1)
+    rng.shuffle(phys)
+    table = phys.reshape(B, NP).astype(np.int32)
+    q, k_new, v_new, dense_k, dense_v = _mk(B, S, T, H, KV, Dh, seed=seed)
+    pk = np.zeros((P, KV, page, Dh), np.float32)
+    pv = np.zeros((P, KV, page, Dh), np.float32)
+    dk, dv = np.asarray(dense_k), np.asarray(dense_v)
+    for b in range(B):
+        for j in range(NP):
+            pk[table[b, j]] = dk[b, :, j * page:(j + 1) * page]
+            pv[table[b, j]] = dv[b, :, j * page:(j + 1) * page]
+    return (q, k_new, v_new, dense_k, dense_v,
+            jnp.asarray(pk), jnp.asarray(pv), jnp.asarray(table))
+
+
+def test_paged_decode_compiled_matches_dense():
+    B, S, H, KV, Dh, page = 4, 512, 32, 4, 64, 128
+    (q, k_new, v_new, dense_k, dense_v, pk, pv, table) = _paged_setup(
+        B, S, 1, H, KV, Dh, page, seed=2)
+    lengths = jnp.asarray([0, 90, 300, 500], jnp.int32)
+    ref, _, _ = dense_cache_attention(
+        q, k_new, v_new, dense_k, dense_v, lengths)
+    attn = jax.jit(make_paged_attention_fn(table, max_seq=S, impl="pallas"))
+    got, _, _ = attn(q, k_new, v_new, pk, pv, lengths)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), **TOL)
+
+
+def test_paged_prefill_compiled_matches_dense():
+    B, S, T, H, KV, Dh, page = 2, 512, 128, 8, 4, 128, 128
+    (q, k_new, v_new, dense_k, dense_v, pk, pv, table) = _paged_setup(
+        B, S, T, H, KV, Dh, page, seed=3)
+    start = jnp.asarray([0, 250], jnp.int32)
+    ref, _, _ = dense_cache_attention(
+        q, k_new, v_new, dense_k, dense_v, start)
+    attn = jax.jit(make_paged_attention_fn(table, max_seq=S, impl="pallas"))
+    got, _, _ = attn(q, k_new, v_new, pk, pv, start)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), **TOL)
