@@ -151,6 +151,26 @@ class InferenceEngine:
         if engine_cfg.kv_layout not in ("contiguous", "paged"):
             raise ValueError(f"unknown kv_layout {engine_cfg.kv_layout!r}")
         self.paged = engine_cfg.kv_layout == "paged"
+        # Sequence parallelism (SURVEY.md §5 long-context): with a `seq`
+        # mesh axis, the KV cache's S dim is sharded across chips and
+        # prefill runs ONE whole-prompt ring-attention program instead of
+        # chunk-at-a-time (a chunk's KV insert would straddle shards; the
+        # ring sees every block exactly once with compute/ICI overlap).
+        self.seq_n = self.mesh.shape.get("seq", 1)
+        if self.seq_n > 1:
+            if self.paged:
+                raise ValueError(
+                    "sequence parallelism requires kv_layout=contiguous "
+                    "(the paged pool is indexed by a replicated page table; "
+                    "sharding pages over `seq` is not supported)")
+            if self.S % self.seq_n:
+                raise ValueError(
+                    f"max_seq_len {self.S} must be divisible by the seq "
+                    f"axis size {self.seq_n}")
+            # One prefill program covering the whole prompt: chunking is
+            # disabled (TTFT tradeoff: a long prompt occupies the engine
+            # for one full-prefill program instead of interleaving).
+            self.prefill_chunk = self.S
 
         # Multi-host: process 0 runs the scheduler and publishes every
         # compiled-program call; followers replay (parallel/multihost.py).
@@ -162,11 +182,29 @@ class InferenceEngine:
             self.B, self.prefill_chunk,
             table_slots=(self.S + page - 1) // page if self.paged else 0)
         self._published_table: np.ndarray | None = None
-        if self.mesh.shape.get("pipe", 1) > 1:
-            raise ValueError(
-                "the serving engine shards DP/TP/EP; pipeline stages are "
-                "provided by parallel.pipeline.pipelined_forward and are "
-                "not yet wired into the engine's compiled programs")
+        # Pipeline parallelism: with a `pipe` axis the compiled programs run
+        # the GPipe schedule (parallel/pipeline.py) — params and KV cache
+        # shard their layer dim per stage, activations hop stage-to-stage
+        # via ppermute. Decode splits the slot batch into `pipe`
+        # microbatches when divisible (else M=1: correct, bubble-heavy).
+        self.pipe_n = self.mesh.shape.get("pipe", 1)
+        if self.pipe_n > 1:
+            if self.paged:
+                raise ValueError(
+                    "pipeline parallelism requires kv_layout=contiguous "
+                    "(the pipelined schedule stages the dense per-layer "
+                    "cache; the paged pool has no layer-contiguous rows)")
+            if self.seq_n > 1:
+                raise ValueError("mesh axes pipe and seq cannot be "
+                                 "combined (pick PP or SP, not both)")
+            if model_cfg.is_moe:
+                raise ValueError(
+                    "pipeline parallelism currently supports the llama "
+                    "family only (MoE layers are not in the staged block)")
+            if model_cfg.n_layers % self.pipe_n:
+                raise ValueError(
+                    f"n_layers {model_cfg.n_layers} not divisible by "
+                    f"pipe={self.pipe_n} stages")
 
         self.tokenizer = load_tokenizer(
             engine_cfg.tokenizer_path or engine_cfg.model_path or None,
@@ -238,7 +276,10 @@ class InferenceEngine:
             self._table_dirty = True
         else:
             from ..parallel.multihost import zeros_global
-            csh = cache_sharding(self.mesh, c.n_kv_heads, self.B)
+            csh = cache_sharding(
+                self.mesh, c.n_kv_heads, self.B,
+                max_seq=self.S if self.seq_n > 1 else None,
+                n_layers=c.n_layers if self.pipe_n > 1 else None)
             shape = (c.n_layers, self.B, c.n_kv_heads, self.S, c.head_dim)
             self.cache = llama.KVCache(
                 k=zeros_global(shape, self.dtype, csh),
@@ -273,6 +314,23 @@ class InferenceEngine:
             model_forward = family_forward
         else:
             model_forward = partial(family_forward, attention_fn=attention_fn)
+        if self.seq_n > 1:
+            # Whole-prompt prefill attends via the ring (queries stay
+            # resident, K/V blocks rotate over ICI); decode keeps the dense
+            # path — GSPMD partitions its S-reductions over the sharded
+            # cache. model_forward above stays the DECODE forward.
+            prefill_forward = partial(
+                family_forward,
+                attention_fn=_ring_prefill_attention_fn(self.mesh))
+        elif self.pipe_n > 1:
+            # Both compiled programs run the GPipe schedule: decode splits
+            # the B slots into `pipe` microbatches (when divisible);
+            # prefill's single-slot row degrades to M=1 (correct,
+            # bubble-heavy — prefill cost is dominated by FLOPs anyway).
+            model_forward = _pipelined_family_forward(self.mesh, self.pipe_n)
+            prefill_forward = model_forward
+        else:
+            prefill_forward = model_forward
 
         replicated = NamedSharding(self.mesh, P())
 
@@ -291,7 +349,7 @@ class InferenceEngine:
             v_row = jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1)
             row_cache = llama.KVCache(k=k_row, v=v_row)
             lengths = start_len[None]
-            logits, row_cache = model_forward(
+            logits, row_cache = prefill_forward(
                 params, c, tokens, lengths, row_cache)
             new_k = jax.lax.dynamic_update_slice_in_dim(
                 cache.k, row_cache.k, slot, axis=1)
@@ -333,6 +391,19 @@ class InferenceEngine:
         if impl not in ("auto", "pallas", "reference"):
             raise ValueError(f"unknown attention impl {impl!r}; "
                              f"expected auto | pallas | reference")
+        if self.seq_n > 1 or self.pipe_n > 1:
+            # The Pallas kernels address a full-extent local cache; with S
+            # sharded over `seq` (or the pipelined schedule, which fixes
+            # its own dense per-stage attention) the path is the
+            # GSPMD-partitioned dense reference.
+            if impl == "pallas":
+                logger.warning("attention=pallas is not available with a "
+                               "seq- or pipe-sharded engine; using reference")
+            else:
+                logger.info("attention: reference (seq/pipe-sharded engine "
+                            "— Pallas kernels need a full-extent local "
+                            "cache)")
+            return "reference"
         if impl == "auto":
             return "pallas" if jax.default_backend() == "tpu" else "reference"
         return impl
@@ -659,6 +730,12 @@ class InferenceEngine:
         silently shift and corrupt earlier KV entries. (Paged layout:
         out-of-range pad positions land on the trash page.)"""
         bucket = min(_bucket(len(chunk), self.prefill_chunk), self.S - pos)
+        if self.seq_n > 1:
+            # Ring attention shards the chunk's T dim over `seq`: round the
+            # bucket up to a multiple of the axis size (pads are causally
+            # invisible to real positions; their K/V lands beyond `lengths`
+            # in the documented undefined zone).
+            bucket = min(-(-bucket // self.seq_n) * self.seq_n, self.S - pos)
         padded = np.zeros((1, bucket), np.int32)
         padded[:, :len(chunk)] = chunk
         table = (self._device_table(),) if self.paged else ()
@@ -893,6 +970,41 @@ class InferenceEngine:
             out["total_pages"] = self.allocator.num_pages - 1
             out["page_size"] = self.allocator.page_size
         return out
+
+
+def _pipelined_family_forward(mesh, n_stages: int):
+    """family-forward adapter running the GPipe schedule
+    (parallel/pipeline.py) — same signature contract as llama.forward, so
+    the engine's prefill/decode step bodies don't change. Microbatch count
+    adapts to the call's batch: `n_stages` when divisible (the schedule's
+    sweet spot), else 1."""
+    from ..parallel.pipeline import pipelined_forward
+
+    def fwd(params, c, tokens, lengths, cache, active=None,
+            attention_fn=None, mlp_fn=None):
+        B = tokens.shape[0]
+        M = n_stages if B % n_stages == 0 else 1
+        return pipelined_forward(params, c, tokens, lengths, cache, mesh,
+                                 M, active=active)
+
+    return fwd
+
+
+def _ring_prefill_attention_fn(mesh):
+    """Whole-prompt prefill attention for a seq-sharded engine: causal ring
+    attention over the chunk itself (prefill always starts at position 0 in
+    seq mode, so the chunk IS the full visible context — no prior cache to
+    attend), plus the standard local KV insert into the S-sharded cache."""
+    from ..parallel.ring_attention import ring_attention
+
+    def attention_fn(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
+        B, T, H, Dh = q.shape
+        attn = ring_attention(q, k_new, v_new, mesh, axis="seq", causal=True)
+        layer_k, layer_v = llama.insert_kv(layer_k, layer_v, k_new, v_new,
+                                           lengths, active)
+        return attn.reshape(B, T, H * Dh), layer_k, layer_v
+
+    return attention_fn
 
 
 def _decode_programs(one_step, n_burst: int):

@@ -20,6 +20,9 @@ Wire format: ONE int32 vector per command, shape ``[HEADER + payload]``
   * SHUTDOWN:       opcode 0
   * PREFILL_CHUNK:  opcode 1, a=slot, b=pos, payload=token ids (the
     compile bucket is derived per-process from pos+len+config)
+  * PREFILL_PART:   opcode 3, same operands — one segment of a chunk
+    longer than a frame's token capacity (TOKEN_FRAME_CAP); the follower
+    concatenates parts, in order, onto the final PREFILL_CHUNK frame
   * DECODE_BURST:   opcode 2, a=n_steps, payload = packed slot state —
     lengths[B], active[B], last_token[B], top_k[B] (int32) then
     temperature[B], top_p[B] (float32 bit-cast) then rng key (uint32
@@ -52,6 +55,13 @@ HEADER = 8
 OP_SHUTDOWN = 0
 OP_PREFILL = 1
 OP_DECODE = 2
+OP_PREFILL_PART = 3
+
+# Token capacity cap per frame: keeps the FIXED frame width small even when
+# the prefill bucket is the whole max_seq_len (seq-parallel engines) — a
+# long prompt is shipped as OP_PREFILL_PART segments followed by the final
+# OP_PREFILL, instead of sizing every frame (decode bursts included) to S.
+TOKEN_FRAME_CAP = 2048
 
 
 def is_multihost() -> bool:
@@ -111,11 +121,13 @@ class HostBridge:
         # that orders every compiled call (VERDICT r1 item 5).
         self.table_size = batch_size * table_slots
         self.table_slots = table_slots
-        # Payload must fit the larger of: a prefill chunk's token ids, or
-        # the packed decode state (4 int + 2 float vectors of B, + 2 key),
-        # plus the page table tail.
-        self.payload = max(prefill_bucket_max,
-                           6 * batch_size + 2) + self.table_size
+        # Payload must fit the larger of: one prefill token segment (capped
+        # — longer chunks ship as multiple frames), or the packed decode
+        # state (4 int + 2 float vectors of B, + 2 key), plus the page
+        # table tail.
+        self.token_capacity = max(min(prefill_bucket_max, TOKEN_FRAME_CAP),
+                                  6 * batch_size + 2)
+        self.payload = self.token_capacity + self.table_size
         self.width = HEADER + self.payload
         if self.enabled:
             logger.info(
@@ -164,12 +176,19 @@ class HostBridge:
     def publish_prefill(self, slot: int, pos: int, tokens: np.ndarray,
                         table: np.ndarray | None = None) -> None:
         """The compile bucket is NOT on the wire: every process derives it
-        from (pos, len(tokens)) + engine config, so it cannot diverge."""
+        from (pos, len(tokens)) + engine config, so it cannot diverge.
+        Chunks longer than one frame's token capacity ship as PART frames
+        the follower reassembles in order."""
         if not self.enabled:
             return
         self._check_live()
-        self._broadcast(self._frame(OP_PREFILL, slot, pos,
-                                    payload=tokens.astype(np.int32),
+        t = tokens.astype(np.int32)
+        cap = self.token_capacity
+        while len(t) > cap:
+            self._broadcast(self._frame(OP_PREFILL_PART, slot, pos,
+                                        payload=t[:cap]))
+            t = t[cap:]
+        self._broadcast(self._frame(OP_PREFILL, slot, pos, payload=t,
                                     table=table))
 
     def pack_decode_state(self, lengths, active, last_token, top_k,
@@ -222,6 +241,7 @@ class HostBridge:
         their last argument."""
         assert self.enabled and not is_coordinator()
         logger.info("follower %d: entering replay loop", jax.process_index())
+        parts: list[np.ndarray] = []
         while True:
             cmd = self._broadcast(None)
             op = int(cmd[0])
@@ -231,7 +251,12 @@ class HostBridge:
             n = int(cmd[4])
             payload = cmd[HEADER:HEADER + n]
             table = self._parse_table(cmd)
-            if op == OP_PREFILL:
+            if op == OP_PREFILL_PART:
+                parts.append(payload.copy())
+            elif op == OP_PREFILL:
+                if parts:
+                    payload = np.concatenate(parts + [payload])
+                    parts = []
                 on_prefill(int(cmd[1]), int(cmd[2]), payload, table)
             elif op == OP_DECODE:
                 on_decode(int(cmd[1]), self.unpack_decode_state(payload),

@@ -95,14 +95,19 @@ def spec_for_param(path: str, shape: tuple[int, ...], mesh: Mesh) -> NamedShardi
     return NamedSharding(mesh, _spec_for(path, shape, mesh))
 
 
-def cache_sharding(mesh: Mesh, n_kv_heads: int, batch: int) -> NamedSharding:
+def cache_sharding(mesh: Mesh, n_kv_heads: int, batch: int,
+                   max_seq: int | None = None,
+                   n_layers: int | None = None) -> NamedSharding:
     """KV cache [L, B, KV, S, Dh] (head-major): batch on data, KV heads on
-    model. (Pipeline stages shard the layer dim themselves —
-    parallel/pipeline.py builds its own specs; the serving engine rejects
-    pipe>1 meshes until PP is wired into its compiled programs.)"""
+    model; in a sequence-parallel engine S shards on ``seq`` (ring prefill
+    writes each shard locally, decode reductions are GSPMD-partitioned);
+    in a pipelined engine L shards on ``pipe`` so each stage holds only its
+    own layers' cache (matching parallel/pipeline.py's stage specs)."""
     return NamedSharding(mesh, P(
-        None, _axis(mesh, "data", batch),
-        _axis(mesh, "model", n_kv_heads), None, None))
+        _axis(mesh, "pipe", n_layers) if n_layers else None,
+        _axis(mesh, "data", batch),
+        _axis(mesh, "model", n_kv_heads),
+        _axis(mesh, "seq", max_seq) if max_seq else None, None))
 
 
 def paged_cache_sharding(mesh: Mesh, n_kv_heads: int) -> NamedSharding:

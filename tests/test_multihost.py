@@ -83,3 +83,39 @@ async def test_engine_start_terminal_after_multihost_shutdown():
     eng._bridge._shutdown_sent = True
     with pytest.raises(RuntimeError, match="terminal"):
         await eng.start()
+
+
+def test_bridge_prefill_segmentation_roundtrip(monkeypatch):
+    """A prefill chunk longer than one frame's token capacity ships as
+    PART frames + a final PREFILL frame; the follower reassembles the
+    exact token sequence. Keeps the fixed frame width small (decode bursts
+    don't pay for a seq-mode whole-prompt bucket)."""
+    import numpy as np
+    from llmapigateway_tpu.parallel import multihost as mh
+
+    send = mh.HostBridge(2, 8192, table_slots=4)
+    send.enabled = True
+    assert send.token_capacity == mh.TOKEN_FRAME_CAP     # capped, not 8192
+    frames = []
+    send._broadcast = lambda cmd=None: (frames.append(cmd.copy()), cmd)[1]
+
+    tokens = (np.arange(5000) % 997).astype(np.int32)
+    table = np.arange(8, dtype=np.int32).reshape(2, 4)
+    send.publish_prefill(1, 0, tokens, table=table)
+    send.publish_shutdown()
+    assert len(frames) == 4                              # 2 parts + exec + shutdown
+
+    recv = mh.HostBridge(2, 8192, table_slots=4)
+    recv.enabled = True
+    feed = iter(frames)
+    recv._broadcast = lambda cmd=None: next(feed)
+    monkeypatch.setattr(mh, "is_coordinator", lambda: False)
+
+    got = []
+    recv.follow(lambda s, p, toks, tbl: got.append((s, p, toks, tbl)),
+                lambda *a: got.append(("decode",) + a))
+    assert len(got) == 1
+    slot, pos, toks, tbl = got[0]
+    assert (slot, pos) == (1, 0)
+    np.testing.assert_array_equal(toks, tokens)
+    np.testing.assert_array_equal(tbl, table)

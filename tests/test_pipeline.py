@@ -92,3 +92,58 @@ def test_pipeline_rejects_bad_shapes(setup):
     with pytest.raises(ValueError, match="not divisible"):
         pipelined_forward(params, cfg, jnp.zeros((3, 2), jnp.int32),
                           jnp.zeros((3,), jnp.int32), cache, mesh, 2)
+
+
+# ---------------------------------------------------------------------------
+# PP IN THE SERVING ENGINE (VERDICT r1 item 4): pipe=2 engine serving must
+# produce the same greedy tokens as a single-device engine — params and KV
+# cache layer dims staged over `pipe`, decode microbatched over the slots.
+# ---------------------------------------------------------------------------
+
+async def test_engine_serves_with_pipeline_stages():
+    from llmapigateway_tpu.config.schemas import LocalEngineConfig
+    from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
+
+    prompt = list((np.arange(50) * 11 + 2) % 500)
+
+    async def run(mesh, devices):
+        cfg = LocalEngineConfig(
+            preset="tiny-test", max_batch_size=2, max_seq_len=128,
+            prefill_chunk=32, dtype="float32", mesh=mesh,
+            attention="reference")
+        eng = InferenceEngine(cfg, devices=devices)
+        try:
+            req = GenRequest(prompt_ids=list(prompt), max_tokens=6,
+                             temperature=0.0)
+            await eng.submit(req)
+            async for _ in eng.stream(req):
+                pass
+            assert req.finish_reason is not None
+            return eng, req.generated
+        finally:
+            await eng.stop()
+
+    cpus = jax.devices("cpu")
+    eng_pp, toks_pp = await run({"pipe": 2}, cpus[:2])
+    assert eng_pp.pipe_n == 2
+    # Params and cache layer dims really are staged.
+    assert eng_pp.cache.k.sharding.spec[0] == "pipe"
+    _, toks_ref = await run({}, cpus[:1])
+    assert toks_pp == toks_ref, (toks_pp, toks_ref)
+
+
+async def test_engine_pipe_rejects_paged_and_moe():
+    import pytest
+    from llmapigateway_tpu.config.schemas import LocalEngineConfig
+    from llmapigateway_tpu.engine.engine import InferenceEngine
+
+    with pytest.raises(ValueError, match="pipeline parallelism"):
+        InferenceEngine(LocalEngineConfig(
+            preset="tiny-test", max_batch_size=2, max_seq_len=128,
+            mesh={"pipe": 2}, kv_layout="paged"),
+            devices=jax.devices("cpu")[:2])
+    with pytest.raises(ValueError, match="llama family"):
+        InferenceEngine(LocalEngineConfig(
+            preset="tiny-moe-test", max_batch_size=2, max_seq_len=128,
+            mesh={"pipe": 2}),
+            devices=jax.devices("cpu")[:2])

@@ -90,3 +90,56 @@ def test_ring_and_ulysses_agree():
     uly = jax.jit(lambda a, b, c: ulysses_attention(a, b, c, mesh))(*args)
     np.testing.assert_allclose(np.asarray(ring), np.asarray(uly),
                                rtol=2e-5, atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# Sequence parallelism IN THE SERVING ENGINE (VERDICT r1 item 3): a prompt
+# served over a seq-sharded mesh must produce the same greedy tokens as a
+# single-device engine — prefill is one whole-prompt ring-attention program
+# and the KV cache's S dim is sharded across the 4 virtual devices.
+# ---------------------------------------------------------------------------
+
+async def test_engine_serves_seq_sharded_prompt():
+    from llmapigateway_tpu.config.schemas import LocalEngineConfig
+    from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
+
+    prompt = list((np.arange(100) * 7 + 3) % 500)
+
+    async def run(mesh, devices):
+        cfg = LocalEngineConfig(
+            preset="tiny-test", max_batch_size=2, max_seq_len=128,
+            prefill_chunk=32, dtype="float32", mesh=mesh,
+            attention="reference")
+        eng = InferenceEngine(cfg, devices=devices)
+        try:
+            req = GenRequest(prompt_ids=list(prompt), max_tokens=8,
+                             temperature=0.0)
+            await eng.submit(req)
+            async for _ in eng.stream(req):
+                pass
+            assert req.finish_reason is not None
+            return eng, req.generated
+        finally:
+            await eng.stop()
+
+    cpus = jax.devices("cpu")
+    eng_seq, toks_seq = await run({"seq": 4}, cpus[:4])
+    assert eng_seq.seq_n == 4
+    # The cache really is sequence-sharded: S dim carries the seq axis.
+    spec = eng_seq.cache.k.sharding.spec
+    assert spec[3] == "seq", f"cache S dim not seq-sharded: {spec}"
+
+    _, toks_ref = await run({}, cpus[:1])
+    assert toks_seq == toks_ref, (toks_seq, toks_ref)
+
+
+async def test_engine_seq_mode_rejects_paged():
+    import pytest
+    from llmapigateway_tpu.config.schemas import LocalEngineConfig
+    from llmapigateway_tpu.engine.engine import InferenceEngine
+
+    with pytest.raises(ValueError, match="sequence parallelism"):
+        InferenceEngine(LocalEngineConfig(
+            preset="tiny-test", max_batch_size=2, max_seq_len=128,
+            mesh={"seq": 4}, kv_layout="paged"),
+            devices=jax.devices("cpu")[:4])
