@@ -155,12 +155,12 @@ def fill_and_time_decode(engine, args) -> dict:
         pos = 0
         while pos < len(prompt):
             chunk = prompt[pos:pos + engine.prefill_chunk]
-            row, engine.cache = engine._exec_prefill(slot, pos, chunk)
+            first, engine.cache = engine._exec_prefill(slot, pos, chunk)
             pos += len(chunk)
         engine.lengths[slot] = len(prompt)
         engine.active[slot] = True
         engine.last_token[slot] = 1
-        np.asarray(row[:1])              # real sync through the tunnel
+        np.asarray(first)                # real sync through the tunnel
     prefill_s = time.monotonic() - t0
     note(f"prefill done: {B}x{args.prompt_len} tok in {prefill_s:.1f}s "
          f"(includes prefill compile)")

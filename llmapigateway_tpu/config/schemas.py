@@ -43,7 +43,14 @@ class LocalEngineConfig(BaseModel):
     decode_burst: int = 8           # chained decode steps per host sync
     max_tokens_default: int = 1024
     attention: str = "auto"         # "auto" | "pallas" | "reference"
+    # Attention pattern for a seq-sharded mesh: "ring" rotates KV blocks over
+    # ICI (works for any head count); "ulysses" all-to-alls heads<->sequence
+    # (cheaper collective when n_kv_heads >= seq axis size).
+    seq_attention: str = "ring"     # "ring" | "ulysses"
     tokenizer_path: str | None = None
+    # Persistent XLA compilation cache: second engine init skips the 30-60 s
+    # trace+compile. "" → ~/.cache/llmapigateway_tpu/xla; "off" disables.
+    compilation_cache_dir: str = ""
     # Numerics sanitizer (SURVEY.md §5 "race detection / sanitizers"): raise
     # on NaN production inside compiled programs (costs performance; debug).
     debug_nans: bool = False

@@ -213,6 +213,7 @@ class InferenceEngine:
         self.fault_plan: FaultPlan | None = None
         self._prev_debug_nans: bool | None = None
         self._enable_debug_nans()
+        _enable_compilation_cache(engine_cfg.compilation_cache_dir)
 
         self._init_params()
         self._init_state()
@@ -227,6 +228,7 @@ class InferenceEngine:
         self._loop_task: asyncio.Task | None = None
         self._stopped = False
         self._work_event = asyncio.Event()
+        self._warm_thread = None
 
     # -- initialization ------------------------------------------------------
     def _init_params(self) -> None:
@@ -337,13 +339,20 @@ class InferenceEngine:
         @partial(jax.jit, donate_argnums=(1,))
         def prefill_step(params, cache: llama.KVCache, tokens: jax.Array,
                          start_len: jax.Array, slot: jax.Array,
-                         last_idx: jax.Array
+                         last_idx: jax.Array, samp_t: jax.Array,
+                         samp_p: jax.Array, samp_k: jax.Array,
+                         key: jax.Array
                          ) -> tuple[jax.Array, llama.KVCache]:
             """Run one prompt chunk for one slot. tokens [1, C]. Returns
-            only the last REAL position's logits row [V], replicated — the
-            single row the scheduler samples from; fetching (or indexing)
-            anything else on the host would be a global op that every
-            process in a multi-host deployment must join."""
+            (first_token [replicated scalar], cache). The first token is
+            sampled INSIDE this program from the last REAL position's
+            logits row — through a remote-device link every extra compiled
+            call in the TTFT path costs a full dispatch round trip (~64 ms
+            on the axon tunnel), so prefill→row-fetch→sample-one (3 calls)
+            is folded into one. Fetching anything here would also be a
+            global op every process of a multi-host deployment must join;
+            followers run the same program with dummy sampling inputs and
+            ignore the token."""
             # Slice this slot's cache rows: [L, 1, KV, S, Dh].
             k_row = jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1)
             v_row = jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1)
@@ -358,11 +367,16 @@ class InferenceEngine:
             row = jax.lax.with_sharding_constraint(
                 jax.lax.dynamic_index_in_dim(logits[0], last_idx, 0,
                                              keepdims=False), replicated)
-            return row, llama.KVCache(k=new_k, v=new_v)
+            samp = SamplingParams(temperature=samp_t[None],
+                                  top_p=samp_p[None], top_k=samp_k[None])
+            first = jax.lax.with_sharding_constraint(
+                sample(row[None], samp, key)[0], replicated)
+            return first, llama.KVCache(k=new_k, v=new_v)
 
         def one_step(params, cache: llama.KVCache, tokens: jax.Array,
                      lengths: jax.Array, active: jax.Array,
-                     samp: SamplingParams, key: jax.Array
+                     samp: SamplingParams, key: jax.Array, *,
+                     greedy: bool = False
                      ) -> tuple[jax.Array, jax.Array, llama.KVCache]:
             """One decode step — the ONE copy of the forward+sample+advance
             body; both compiled programs below are built from it. Returns
@@ -371,18 +385,24 @@ class InferenceEngine:
             asynchronously, steps behind (the tunnel's per-fetch latency is
             ~40 ms; chained dispatch amortizes it). Sampled tokens are
             pinned replicated so the host fetch is local on every process
-            of a multi-host mesh."""
+            of a multi-host mesh. ``greedy=True`` compiles the
+            argmax-only variant — it skips the full-vocab sort the general
+            sampler pays per step; the scheduler picks it whenever every
+            active slot has temperature 0 (the common serving case)."""
             logits, cache = model_forward(
                 params, c, tokens[:, None], lengths, cache, active=active)
+            if greedy:
+                next_tokens = jnp.argmax(
+                    logits[:, 0, :], axis=-1).astype(jnp.int32)
+            else:
+                next_tokens = sample(logits[:, 0, :], samp, key)
             next_tokens = jax.lax.with_sharding_constraint(
-                sample(logits[:, 0, :], samp, key), replicated)
+                next_tokens, replicated)
             new_lengths = jnp.where(active, lengths + 1, lengths)
             return next_tokens, new_lengths, cache
 
         self._prefill_fn = prefill_step
-        self._decode_fn, self._decode_scan_fn = _decode_programs(
-            one_step, self.decode_burst)
-        self._sample_one = _jit_sample_one()
+        self._decode_fns = _decode_programs(one_step, self.decode_burst)
 
     def _resolve_attention_impl(self) -> str:
         """Validate cfg.attention and resolve "auto" (pallas on real TPU;
@@ -427,12 +447,14 @@ class InferenceEngine:
         @partial(jax.jit, donate_argnums=(1,))
         def prefill_step(params, cache: PagedKVCache, table: jax.Array,
                          tokens: jax.Array, start_len: jax.Array,
-                         slot: jax.Array, last_idx: jax.Array
+                         slot: jax.Array, last_idx: jax.Array,
+                         samp_t: jax.Array, samp_p: jax.Array,
+                         samp_k: jax.Array, key: jax.Array
                          ) -> tuple[jax.Array, PagedKVCache]:
             """One prompt chunk for one slot. tokens [1, C]; the pool is
             global, so unlike the dense path there is no per-slot row slice
-            — the slot's page-table row does the routing. Returns the last
-            real position's logits row [V] (see dense twin)."""
+            — the slot's page-table row does the routing. Returns (first
+            sampled token, cache) — sampling folded in, see dense twin."""
             row = jax.lax.dynamic_slice_in_dim(table, slot, 1, axis=0)
             attn = make_paged_attention_fn(row, max_seq=S, impl=impl,
                                            mesh=mesh)
@@ -441,12 +463,16 @@ class InferenceEngine:
             out = jax.lax.with_sharding_constraint(
                 jax.lax.dynamic_index_in_dim(logits[0], last_idx, 0,
                                              keepdims=False), replicated)
-            return out, PagedKVCache(k=cache.k, v=cache.v)
+            samp = SamplingParams(temperature=samp_t[None],
+                                  top_p=samp_p[None], top_k=samp_k[None])
+            first = jax.lax.with_sharding_constraint(
+                sample(out[None], samp, key)[0], replicated)
+            return first, PagedKVCache(k=cache.k, v=cache.v)
 
         def one_step(params, cache: PagedKVCache, table: jax.Array,
                      tokens: jax.Array, lengths: jax.Array,
                      active: jax.Array, samp: SamplingParams,
-                     key: jax.Array):
+                     key: jax.Array, *, greedy: bool = False):
             """Paged one-step twin (page table routes the cache rows). The
             table is loop-invariant under the burst scan — pages are
             reserved for a request's whole lifetime at admission, so no
@@ -456,20 +482,63 @@ class InferenceEngine:
             logits, cache = family_forward(
                 params, c, tokens[:, None], lengths, cache, active=active,
                 attention_fn=attn)
+            if greedy:
+                next_tokens = jnp.argmax(
+                    logits[:, 0, :], axis=-1).astype(jnp.int32)
+            else:
+                next_tokens = sample(logits[:, 0, :], samp, key)
             next_tokens = jax.lax.with_sharding_constraint(
-                sample(logits[:, 0, :], samp, key), replicated)
+                next_tokens, replicated)
             new_lengths = jnp.where(active, lengths + 1, lengths)
             return (next_tokens, new_lengths,
                     PagedKVCache(k=cache.k, v=cache.v))
 
         self._prefill_fn = prefill_step
-        self._decode_fn, self._decode_scan_fn = _decode_programs(
-            one_step, self.decode_burst)
-        self._sample_one = _jit_sample_one()
+        self._decode_fns = _decode_programs(one_step, self.decode_burst)
+
+    @property
+    def _decode_fn(self):
+        """Back-compat alias: the general-sampler per-step program."""
+        return self._decode_fns[False][0]
+
+    @property
+    def _decode_scan_fn(self):
+        """Back-compat alias: the general-sampler fused-burst program."""
+        return self._decode_fns[False][1]
+
+    def _warm_decode_variants(self) -> None:
+        """AOT lower+compile the greedy AND general decode programs from
+        input avals (no device buffers touched), populating the persistent
+        compilation cache — the eventual first real call of the not-yet-
+        used variant re-traces but hits the disk cache, turning a 30-60 s
+        mid-serving stall into a ~1-2 s one. Best-effort: any failure just
+        means lazy compilation as before."""
+        try:
+            def aval(x):
+                return jax.ShapeDtypeStruct(
+                    x.shape, x.dtype, sharding=getattr(x, "sharding", None))
+            rep = NamedSharding(self.mesh, P())
+
+            def vec(dt):
+                return jax.ShapeDtypeStruct((self.B,), dt, sharding=rep)
+            samp_a = SamplingParams(temperature=vec(jnp.float32),
+                                    top_p=vec(jnp.float32),
+                                    top_k=vec(jnp.int32))
+            table_a = (aval(self._device_table()),) if self.paged else ()
+            args = (jax.tree.map(aval, self.params),
+                    jax.tree.map(aval, self.cache), *table_a,
+                    vec(jnp.int32), vec(jnp.int32), vec(jnp.bool_),
+                    samp_a, aval(self._rng))
+            for greedy in (False, True):
+                step, scan = self._decode_fns[greedy]
+                (scan if scan is not None else step).lower(*args).compile()
+        except Exception:
+            logger.debug("decode program pre-warm failed", exc_info=True)
 
     def _device_table(self) -> jax.Array:
         if self._table_dirty or self._d_table is None:
-            self._d_table = jnp.asarray(self.allocator.table)
+            self._d_table = jax.device_put(
+                self.allocator.table, NamedSharding(self.mesh, P()))
             self._table_dirty = False
         return self._d_table
 
@@ -515,6 +584,15 @@ class InferenceEngine:
             self._enable_debug_nans()
             self._loop_task = asyncio.get_running_loop().create_task(
                 self._run_loop())
+        if self._warm_thread is None and jax.default_backend() == "tpu":
+            # Pre-lower+compile BOTH sampler variants into the persistent
+            # compilation cache off-thread: without this, the first
+            # temperature>0 request after a greedy-only warm-up stalls
+            # every in-flight decode for a full XLA compile.
+            import threading
+            self._warm_thread = threading.Thread(
+                target=self._warm_decode_variants, daemon=True)
+            self._warm_thread.start()
 
     async def stop(self) -> None:
         self._stopped = True
@@ -694,19 +772,19 @@ class InferenceEngine:
             self.fault_plan.on_prefill()
         self._bridge.publish_prefill(slot, pos, chunk,
                                      table=self._table_to_publish())
-        row, self.cache = self._exec_prefill(slot, pos, chunk)
+        self._rng, key = jax.random.split(self._rng)
+        first, self.cache = self._exec_prefill(
+            slot, pos, chunk,
+            samp=(req.temperature, req.top_p, req.top_k), key=key)
         req.prefill_pos = pos + len(chunk)
         if req.prefill_pos < len(ids):
             return False
 
-        # Prompt complete: sample the first token from the last real
-        # position — on the HOST-fetched row via a purely local program
-        # (followers never sample; the token reaches them inside the next
-        # decode burst's broadcast state).
-        self._rng, key = jax.random.split(self._rng)
-        first = self._sample_one(
-            np.asarray(row), np.float32(req.temperature),
-            np.float32(req.top_p), np.int32(req.top_k), key)
+        # Prompt complete: the first token was sampled inside the prefill
+        # program (see prefill_step) — ONE host fetch completes the TTFT
+        # path. Followers of a multi-host mesh ran the same program with
+        # dummy sampling inputs and never fetch; the real token reaches
+        # them inside the next decode burst's broadcast state.
         first_id = int(first)
         req.generated.append(first_id)
         req.t_first_token = time.monotonic()
@@ -719,16 +797,21 @@ class InferenceEngine:
         self._d_dirty = True
         return True
 
-    def _exec_prefill(self, slot: int, pos: int, chunk: np.ndarray):
+    def _exec_prefill(self, slot: int, pos: int, chunk: np.ndarray,
+                      samp: tuple[float, float, int] | None = None,
+                      key: jax.Array | None = None):
         """The one compiled-prefill call — identical on coordinator and
         followers (np/uncommitted inputs are auto-replicated, so the same
-        call works single-process and across a multi-host mesh). The
-        compile bucket is derived here, from (pos, len(chunk)) and engine
-        config, so coordinator/followers/bench can never disagree on it.
-        Clamped so pos+bucket never exceeds the cache extent S: XLA clamps
+        call works single-process and across a multi-host mesh; followers
+        pass no sampling state and ignore the sampled token — the cache
+        update is input-value-identical either way). The compile bucket is
+        derived here, from (pos, len(chunk)) and engine config, so
+        coordinator/followers/bench can never disagree on it. Clamped so
+        pos+bucket never exceeds the cache extent S: XLA clamps
         dynamic_update_slice starts, so an overrunning padded chunk would
         silently shift and corrupt earlier KV entries. (Paged layout:
-        out-of-range pad positions land on the trash page.)"""
+        out-of-range pad positions land on the trash page.)
+        Returns (first_token [replicated scalar device array], cache)."""
         bucket = min(_bucket(len(chunk), self.prefill_chunk), self.S - pos)
         if self.seq_n > 1:
             # Ring attention shards the chunk's T dim over `seq`: round the
@@ -739,9 +822,13 @@ class InferenceEngine:
         padded = np.zeros((1, bucket), np.int32)
         padded[:, :len(chunk)] = chunk
         table = (self._device_table(),) if self.paged else ()
+        temp, top_p, top_k = samp if samp is not None else (0.0, 1.0, 0)
+        if key is None:
+            key = _DUMMY_KEY()
         return self._prefill_fn(
             self.params, self.cache, *table, padded, np.int32(pos),
-            np.int32(slot), np.int32(len(chunk) - 1))
+            np.int32(slot), np.int32(len(chunk) - 1), np.float32(temp),
+            np.float32(top_p), np.int32(top_k), key)
 
     def _exec_decode(self, n_steps: int, state: dict) -> list[np.ndarray]:
         """Run a burst from broadcast-packed host state (multihost path) —
@@ -754,8 +841,14 @@ class InferenceEngine:
         key = jax.random.wrap_key_data(
             jnp.asarray(state["key"], jnp.uint32))
         table = (self._device_table(),) if self.paged else ()
-        if n_steps == self.decode_burst and self._decode_scan_fn is not None:
-            toks, _, _, self.cache = self._decode_scan_fn(
+        # Greedy fast path: computed from the broadcast state, so every
+        # process of a multi-host mesh picks the same program.
+        greedy = not bool(np.any(
+            np.asarray(state["temperature"])[np.asarray(state["active"])]
+            > 0))
+        step_fn, scan_fn = self._decode_fns[greedy]
+        if n_steps == self.decode_burst and scan_fn is not None:
+            toks, _, _, self.cache = scan_fn(
                 self.params, self.cache, *table, tokens, lengths, active,
                 samp, key)
             host = np.asarray(toks)
@@ -767,7 +860,7 @@ class InferenceEngine:
         pending = []
         for _ in range(n_steps):
             key, sub = jax.random.split(key)
-            tokens, lengths, self.cache = self._decode_fn(
+            tokens, lengths, self.cache = step_fn(
                 self.params, self.cache, *table, tokens, lengths, active,
                 samp, sub)
             try:
@@ -840,23 +933,35 @@ class InferenceEngine:
 
         if self._d_dirty:
             # Host slot state changed (admission/release/prefill): upload once.
-            self._d_tokens = jnp.asarray(self.last_token)
-            self._d_lengths = jnp.asarray(self.lengths)
-            self._d_active = jnp.asarray(self.active)
+            # Pinned to the SAME replicated sharding the compiled programs
+            # produce — a plain jnp.asarray upload carries SingleDeviceSharding
+            # while the program outputs fed back next burst carry
+            # NamedSharding(mesh, P()), and that aval mismatch silently
+            # recompiled the whole burst program on the first post-upload call
+            # (the r2 bench's "64.5 ms/step" was mostly this one recompile).
+            rep = NamedSharding(self.mesh, P())
+            self._d_tokens = jax.device_put(self.last_token, rep)
+            self._d_lengths = jax.device_put(self.lengths, rep)
+            self._d_active = jax.device_put(self.active, rep)
             self._d_samp = SamplingParams(
-                temperature=jnp.asarray(self.samp_temperature),
-                top_p=jnp.asarray(self.samp_top_p),
-                top_k=jnp.asarray(self.samp_top_k))
+                temperature=jax.device_put(self.samp_temperature, rep),
+                top_p=jax.device_put(self.samp_top_p, rep),
+                top_k=jax.device_put(self.samp_top_k, rep))
             self._d_dirty = False
 
         table = (self._device_table(),) if self.paged else ()
-        if n_steps == self.decode_burst and self._decode_scan_fn is not None:
+        # Greedy fast path: when every active slot decodes at temperature 0
+        # (the common case), run the argmax-only program — the general
+        # sampler's full-vocab sort costs measurable per-step time.
+        greedy = not bool(np.any(self.samp_temperature[self.active] > 0))
+        step_fn, scan_fn = self._decode_fns[greedy]
+        if n_steps == self.decode_burst and scan_fn is not None:
             # Full-size burst → the single fused scan program (one dispatch,
             # one fetch). Partial bursts (tail of a request's token budget,
             # or prefill work pending) fall through to the step loop below.
             self._rng, key = jax.random.split(self._rng)
             toks, self._d_tokens, self._d_lengths, self.cache = \
-                self._decode_scan_fn(
+                scan_fn(
                     self.params, self.cache, *table, self._d_tokens,
                     self._d_lengths, self._d_active, self._d_samp, key)
             host = np.asarray(toks)                      # [n_steps, B]
@@ -865,7 +970,7 @@ class InferenceEngine:
             pending: list[jax.Array] = []
             for _ in range(n_steps):
                 self._rng, key = jax.random.split(self._rng)
-                self._d_tokens, self._d_lengths, self.cache = self._decode_fn(
+                self._d_tokens, self._d_lengths, self.cache = step_fn(
                     self.params, self.cache, *table, self._d_tokens,
                     self._d_lengths, self._d_active, self._d_samp, key)
                 try:
@@ -1008,42 +1113,70 @@ def _ring_prefill_attention_fn(mesh):
 
 
 def _decode_programs(one_step, n_burst: int):
-    """Compile the two decode programs from one step body: the per-step
-    program, and (when bursting) the fused lax.scan over `n_burst` steps —
-    ONE dispatch + ONE host fetch per burst instead of per step; through a
+    """Build the decode programs from one step body: the per-step program,
+    and (when bursting) the fused lax.scan over `n_burst` steps — ONE
+    dispatch + ONE host fetch per burst instead of per step; through a
     remote-device tunnel, dispatch latency is the decode bottleneck, not
     FLOPs. `one_step(params, cache, [table,] tokens, lengths, active, samp,
-    key) -> (next_tokens, new_lengths, cache)`."""
-    decode_step = partial(jax.jit, donate_argnums=(1,))(one_step)
+    key, greedy=) -> (next_tokens, new_lengths, cache)`.
 
-    @partial(jax.jit, donate_argnums=(1,))
-    def decode_scan(params, cache, *rest):
-        *table, tokens, lengths, active, samp, key = rest
+    Returns ``{greedy: (step, scan)}`` for greedy in (False, True); the
+    scheduler picks per burst (jit compiles lazily, so an engine that only
+    ever serves one mode compiles one set)."""
+    def build(greedy: bool):
+        step = partial(one_step, greedy=greedy)
+        decode_step = partial(jax.jit, donate_argnums=(1,))(step)
 
-        def body(carry, _):
-            cache, tokens, lengths, key = carry
-            key, sub = jax.random.split(key)
-            nt, nl, cache = one_step(params, cache, *table, tokens, lengths,
-                                     active, samp, sub)
-            return (cache, nt, nl, key), nt
-        (cache, tokens, lengths, key), toks = jax.lax.scan(
-            body, (cache, tokens, lengths, key), None, length=n_burst)
-        return toks, tokens, lengths, cache
+        @partial(jax.jit, donate_argnums=(1,))
+        def decode_scan(params, cache, *rest):
+            *table, tokens, lengths, active, samp, key = rest
 
-    return decode_step, (decode_scan if n_burst > 1 else None)
+            def body(carry, _):
+                cache, tokens, lengths, key = carry
+                key, sub = jax.random.split(key)
+                nt, nl, cache = step(params, cache, *table, tokens,
+                                     lengths, active, samp, sub)
+                return (cache, nt, nl, key), nt
+            (cache, tokens, lengths, key), toks = jax.lax.scan(
+                body, (cache, tokens, lengths, key), None, length=n_burst)
+            return toks, tokens, lengths, cache
+
+        return decode_step, (decode_scan if n_burst > 1 else None)
+
+    return {greedy: build(greedy) for greedy in (False, True)}
 
 
-def _jit_sample_one():
-    """Single-sequence sampler (first token off a prefill's logits) — shared
-    by the dense and paged compile paths."""
-    @jax.jit
-    def sample_one(logits: jax.Array, temperature: jax.Array,
-                   top_p: jax.Array, top_k: jax.Array,
-                   key: jax.Array) -> jax.Array:
-        samp = SamplingParams(temperature=temperature[None],
-                              top_p=top_p[None], top_k=top_k[None])
-        return sample(logits[None], samp, key)[0]
-    return sample_one
+_dummy_key: jax.Array | None = None
+
+
+def _DUMMY_KEY() -> jax.Array:
+    """A fixed typed PRNG key for calls whose sampled output is ignored
+    (multi-host followers, bench prefill) — cached so the input aval is
+    identical across calls (no recompiles)."""
+    global _dummy_key
+    if _dummy_key is None:
+        _dummy_key = jax.random.key(0)
+    return _dummy_key
+
+
+def _enable_compilation_cache(cfg_dir: str) -> None:
+    """Persistent XLA compilation cache (VERDICT r2 item 7): a restarted
+    gateway re-inits its engine in seconds instead of re-compiling for
+    ~60 s (provider builds block on engine init — routing/router.py). The
+    flag is process-global and idempotent; first engine wins."""
+    if cfg_dir.strip().lower() == "off":
+        return
+    import os
+    path = cfg_dir or os.path.join(
+        os.path.expanduser("~"), ".cache", "llmapigateway_tpu", "xla")
+    try:
+        os.makedirs(path, exist_ok=True)
+        if not jax.config.jax_compilation_cache_dir:
+            jax.config.update("jax_compilation_cache_dir", path)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:                     # cache is an optimization only
+        logger.warning("compilation cache unavailable", exc_info=True)
 
 
 def _bucket(n: int, cap: int) -> int:
