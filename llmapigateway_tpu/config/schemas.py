@@ -37,7 +37,9 @@ class LocalEngineConfig(BaseModel):
     max_batch_size: int = 8
     max_seq_len: int = 4096
     kv_layout: str = "contiguous"   # "contiguous" | "paged"
-    kv_page_size: int = 128
+    # Page size doubles as the paged kernel's DMA block; 256 is the
+    # measured-optimal block on v5e (128 costs ~10% decode throughput).
+    kv_page_size: int = 256
     kv_num_pages: int = 0           # 0 → derived from max_batch_size*max_seq_len
     prefill_chunk: int = 512
     decode_burst: int = 8           # chained decode steps per host sync

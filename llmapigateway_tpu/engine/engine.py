@@ -157,6 +157,7 @@ class InferenceEngine:
         # chunk-at-a-time (a chunk's KV insert would straddle shards; the
         # ring sees every block exactly once with compute/ICI overlap).
         self.seq_n = self.mesh.shape.get("seq", 1)
+        self.seq_attention = engine_cfg.seq_attention
         if self.seq_n > 1:
             if self.paged:
                 raise ValueError(
@@ -167,6 +168,21 @@ class InferenceEngine:
                 raise ValueError(
                     f"max_seq_len {self.S} must be divisible by the seq "
                     f"axis size {self.seq_n}")
+            if self.seq_attention not in ("ring", "ulysses"):
+                raise ValueError(
+                    f"unknown seq_attention {self.seq_attention!r}; "
+                    f"expected ring | ulysses")
+            if self.seq_attention == "ulysses" and (
+                    model_cfg.n_heads % self.seq_n
+                    or model_cfg.n_kv_heads % self.seq_n):
+                # Ulysses all-to-alls the head dim across the seq axis —
+                # impossible when heads don't divide. Ring is always legal;
+                # fall back rather than refuse the whole engine.
+                logger.warning(
+                    "seq_attention=ulysses needs heads divisible by the "
+                    "seq axis (H=%d, KV=%d, seq=%d); falling back to ring",
+                    model_cfg.n_heads, model_cfg.n_kv_heads, self.seq_n)
+                self.seq_attention = "ring"
             # One prefill program covering the whole prompt: chunking is
             # disabled (TTFT tradeoff: a long prompt occupies the engine
             # for one full-prefill program instead of interleaving).
@@ -304,6 +320,16 @@ class InferenceEngine:
         self._d_active = None
         self._d_samp = None
         self._d_dirty = True
+        # Lag-one burst pipelining: the scan path dispatches burst N+1
+        # BEFORE fetching burst N's tokens, so the device→host round trip
+        # (~64 ms through a remote tunnel) overlaps the next burst's
+        # compute instead of serializing with it. The stash holds
+        # (device tokens, n_steps, active snapshot, slot epochs) of the
+        # in-flight burst; `_slot_epoch` guards against a slot being
+        # released + re-admitted between dispatch and flush (the stale
+        # burst's token must not clobber the new request's first token).
+        self._pending: tuple | None = None
+        self._slot_epoch = np.zeros((self.B,), np.int64)
 
     def _compile(self) -> None:
         if self.paged:
@@ -317,13 +343,16 @@ class InferenceEngine:
         else:
             model_forward = partial(family_forward, attention_fn=attention_fn)
         if self.seq_n > 1:
-            # Whole-prompt prefill attends via the ring (queries stay
-            # resident, K/V blocks rotate over ICI); decode keeps the dense
-            # path — GSPMD partitions its S-reductions over the sharded
-            # cache. model_forward above stays the DECODE forward.
+            # Whole-prompt prefill attends via the configured seq pattern —
+            # ring (K/V blocks rotate over ICI; any head count) or Ulysses
+            # (two all-to-alls reshard heads<->sequence; cheaper when heads
+            # divide the axis). Decode keeps the dense path — GSPMD
+            # partitions its S-reductions over the sharded cache.
+            # model_forward above stays the DECODE forward.
             prefill_forward = partial(
                 family_forward,
-                attention_fn=_ring_prefill_attention_fn(self.mesh))
+                attention_fn=_seq_prefill_attention_fn(
+                    self.mesh, self.seq_attention))
         elif self.pipe_n > 1:
             # Both compiled programs run the GPipe schedule: decode splits
             # the B slots into `pipe` microbatches (when divisible);
@@ -741,18 +770,27 @@ class InferenceEngine:
         if decoding:
             busy = not self._queue.empty() or bool(self._prefilling)
             burst = 1 if busy else self.decode_burst
-            # Never burst past any slot's cache capacity or token budget.
+            # Never burst past any slot's cache capacity or token budget —
+            # both computed from DISPATCH-TRUE state (self.lengths advances
+            # at dispatch): with lag-one pipelining, len(r.generated) lags
+            # a burst behind and would let a whole discarded burst through.
             for r in decoding:
+                dispatched = int(self.lengths[r.slot]) - len(r.prompt_ids) + 1
                 burst = min(burst,
                             self.S - int(self.lengths[r.slot]),
-                            max(1, r.max_tokens - len(r.generated)))
+                            max(1, r.max_tokens - dispatched))
             burst = max(1, burst)
             step_tokens = await asyncio.to_thread(self._decode_burst, burst)
             for tokens in step_tokens:          # in generation order
                 for req in decoding:
                     if req.done:
                         continue
-                    req.generated.append(int(tokens[req.slot]))
+                    tok = int(tokens[req.slot])
+                    if tok < 0:
+                        # Lag-one pipelining: this token array predates the
+                        # slot's current request (masked in _flush_entry).
+                        continue
+                    req.generated.append(tok)
                     self._emit_token(req)
             return True
         return bool(self._prefilling)
@@ -905,11 +943,39 @@ class InferenceEngine:
         coordinator publishes, until shutdown."""
         self._bridge.follow(self._follow_prefill, self._follow_decode)
 
+    def _flush_pending(self) -> list[np.ndarray]:
+        """Fetch the in-flight burst's tokens (if any) and sync the host
+        ``last_token`` mirror for slots that survived unchanged since its
+        dispatch. Returns the per-step host token arrays, in order."""
+        entry, self._pending = self._pending, None
+        return self._flush_entry(entry)
+
+    def _flush_entry(self, entry) -> list[np.ndarray]:
+        if entry is None:
+            return []
+        toks_dev, n, active_snap, epoch_snap = entry
+        host = np.asarray(toks_dev)                      # [n, B]
+        live = active_snap & (epoch_snap == self._slot_epoch)
+        for slot in np.nonzero(live)[0]:
+            self.last_token[slot] = int(host[-1][slot])
+        if not live.all():
+            # Slots released (or released+re-admitted) since this burst's
+            # dispatch: their tokens belong to a dead request — mask with
+            # -1 so the emission loop can't attribute them to the slot's
+            # CURRENT request.
+            host = host.copy()
+            host[:, ~live] = -1
+        return [host[i] for i in range(n)]
+
     def _decode_burst(self, n_steps: int) -> list[np.ndarray]:
         """Run `n_steps` chained decode steps; tokens/lengths feed back as
         device arrays (no host round-trip inside the chain) and each step's
         sampled tokens are fetched asynchronously behind the dispatch wave.
-        Returns the per-step host token arrays, in order."""
+        Full-size bursts run LAG-ONE pipelined: this call dispatches burst
+        N and returns burst N-1's tokens, so the fetch round trip hides
+        under device compute. Returns host token arrays in generation
+        order (possibly from the previous burst; possibly two bursts'
+        worth when a flush was forced)."""
         if self.fault_plan:
             self.fault_plan.on_decode()
         if self._bridge.enabled:
@@ -931,14 +997,19 @@ class InferenceEngine:
                 self.last_token[slot] = int(step_tokens[-1][slot])
             return step_tokens
 
+        pre: list[np.ndarray] = []
         if self._d_dirty:
-            # Host slot state changed (admission/release/prefill): upload once.
-            # Pinned to the SAME replicated sharding the compiled programs
-            # produce — a plain jnp.asarray upload carries SingleDeviceSharding
-            # while the program outputs fed back next burst carry
-            # NamedSharding(mesh, P()), and that aval mismatch silently
-            # recompiled the whole burst program on the first post-upload call
-            # (the r2 bench's "64.5 ms/step" was mostly this one recompile).
+            # Host slot state changed (admission/release/prefill). The
+            # in-flight burst must land first: the upload below reads the
+            # host `last_token` mirror, which that burst's tokens update.
+            pre = self._flush_pending()
+            # Upload once, pinned to the SAME replicated sharding the
+            # compiled programs produce — a plain jnp.asarray upload
+            # carries SingleDeviceSharding while the program outputs fed
+            # back next burst carry NamedSharding(mesh, P()), and that
+            # aval mismatch silently recompiled the whole burst program on
+            # the first post-upload call (the r2 bench's "64.5 ms/step"
+            # was mostly this one recompile).
             rep = NamedSharding(self.mesh, P())
             self._d_tokens = jax.device_put(self.last_token, rep)
             self._d_lengths = jax.device_put(self.lengths, rep)
@@ -956,34 +1027,49 @@ class InferenceEngine:
         greedy = not bool(np.any(self.samp_temperature[self.active] > 0))
         step_fn, scan_fn = self._decode_fns[greedy]
         if n_steps == self.decode_burst and scan_fn is not None:
-            # Full-size burst → the single fused scan program (one dispatch,
-            # one fetch). Partial bursts (tail of a request's token budget,
-            # or prefill work pending) fall through to the step loop below.
+            # Full-size burst → the single fused scan program, lag-one
+            # pipelined: dispatch burst N, then fetch burst N-1 — its
+            # device→host copy was queued at its own dispatch
+            # (copy_to_host_async), so the transfer streamed while burst N
+            # computes and the asarray below is (near-)immediate. Partial
+            # bursts (tail of a request's token budget, or prefill work
+            # pending) fall through to the synchronous step loop below.
             self._rng, key = jax.random.split(self._rng)
             toks, self._d_tokens, self._d_lengths, self.cache = \
                 scan_fn(
                     self.params, self.cache, *table, self._d_tokens,
                     self._d_lengths, self._d_active, self._d_samp, key)
-            host = np.asarray(toks)                      # [n_steps, B]
-            step_tokens = [host[i] for i in range(n_steps)]
-        else:
-            pending: list[jax.Array] = []
-            for _ in range(n_steps):
-                self._rng, key = jax.random.split(self._rng)
-                self._d_tokens, self._d_lengths, self.cache = step_fn(
-                    self.params, self.cache, *table, self._d_tokens,
-                    self._d_lengths, self._d_active, self._d_samp, key)
-                try:
-                    self._d_tokens.copy_to_host_async()
-                except Exception:       # backend without async copies
-                    pass
-                pending.append(self._d_tokens)
-            step_tokens = [np.asarray(t) for t in pending]
+            try:
+                toks.copy_to_host_async()
+            except Exception:           # backend without async copies
+                pass
+            prev, self._pending = self._pending, (
+                toks, n_steps, self.active.copy(), self._slot_epoch.copy())
+            # Host length mirror advances at DISPATCH time — the burst-
+            # capping logic in _step must see the device-true lengths.
+            self.lengths[self.active] += n_steps
+            return pre + self._flush_entry(prev)
+
+        # Synchronous path: flush any in-flight burst first so tokens are
+        # returned in generation order.
+        pre += self._flush_pending()
+        pending: list[jax.Array] = []
+        for _ in range(n_steps):
+            self._rng, key = jax.random.split(self._rng)
+            self._d_tokens, self._d_lengths, self.cache = step_fn(
+                self.params, self.cache, *table, self._d_tokens,
+                self._d_lengths, self._d_active, self._d_samp, key)
+            try:
+                self._d_tokens.copy_to_host_async()
+            except Exception:           # backend without async copies
+                pass
+            pending.append(self._d_tokens)
+        step_tokens = [np.asarray(t) for t in pending]
         # Mirror device-side length advance on the host.
         self.lengths[self.active] += n_steps
         for slot in np.nonzero(self.active)[0]:
             self.last_token[slot] = int(step_tokens[-1][slot])
-        return step_tokens
+        return pre + step_tokens
 
     # -- emission / lifecycle (event-loop thread only) ------------------------
     def _emit_token(self, req: GenRequest) -> None:
@@ -1055,6 +1141,7 @@ class InferenceEngine:
             self.active[req.slot] = False
             self.lengths[req.slot] = 0
             self._free_slots.append(req.slot)
+            self._slot_epoch[req.slot] += 1
             self._d_dirty = True
             if self.paged:
                 self.allocator.release(req.slot)
@@ -1095,16 +1182,21 @@ def _pipelined_family_forward(mesh, n_stages: int):
     return fwd
 
 
-def _ring_prefill_attention_fn(mesh):
-    """Whole-prompt prefill attention for a seq-sharded engine: causal ring
+def _seq_prefill_attention_fn(mesh, kind: str = "ring"):
+    """Whole-prompt prefill attention for a seq-sharded engine: causal
     attention over the chunk itself (prefill always starts at position 0 in
     seq mode, so the chunk IS the full visible context — no prior cache to
-    attend), plus the standard local KV insert into the S-sharded cache."""
+    attend), plus the standard local KV insert into the S-sharded cache.
+    ``kind`` picks the collective pattern: "ring" (n-1 ppermute hops, any
+    head count) or "ulysses" (2 all-to-alls, needs heads % seq == 0)."""
     from ..parallel.ring_attention import ring_attention
+    from ..parallel.ulysses import ulysses_attention
+
+    op = ring_attention if kind == "ring" else ulysses_attention
 
     def attention_fn(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
         B, T, H, Dh = q.shape
-        attn = ring_attention(q, k_new, v_new, mesh, axis="seq", causal=True)
+        attn = op(q, k_new, v_new, mesh, axis="seq", causal=True)
         layer_k, layer_v = llama.insert_kv(layer_k, layer_v, k_new, v_new,
                                            lengths, active)
         return attn.reshape(B, T, H * Dh), layer_k, layer_v

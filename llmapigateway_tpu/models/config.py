@@ -72,6 +72,14 @@ PRESETS: dict[str, ModelConfig] = {
     "tinyllama-1.1b": ModelConfig(
         vocab_size=32000, d_model=2048, n_layers=22, n_heads=32, n_kv_heads=4,
         d_ff=5632, rope_theta=10000.0, max_seq_len=2048),
+    # ~3B-class llama geometry (TPU-friendly head_dim=128, GQA 24/8):
+    # ~3.2B params ≈ 6.4 GB bf16 — the largest preset that comfortably
+    # fits one 16 GB v5e chip with a bs=8 KV cache. The bench ladder's mid
+    # rung between TinyLlama and 8B (higher arithmetic intensity; shows
+    # whether MFU scales with model width).
+    "llama-3b-class": ModelConfig(
+        vocab_size=32000, d_model=3072, n_layers=28, n_heads=24,
+        n_kv_heads=8, d_ff=8192, rope_theta=10000.0, max_seq_len=2048),
     # Llama-3-8B (HF: meta-llama/Meta-Llama-3-8B-Instruct).
     "llama-3-8b": ModelConfig(
         vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,

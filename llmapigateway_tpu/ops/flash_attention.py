@@ -55,7 +55,7 @@ def _interpret_default() -> bool:
 # Decode kernel: q [B, KV, G, Dh] vs cache [B, KV, S, Dh], ragged by n_valid
 # ---------------------------------------------------------------------------
 
-def _decode_kernel(nvalid_ref, q_ref, k_ref, v_ref, o_ref,
+def _decode_kernel(nvalid_ref, q_ref, kn_ref, vn_ref, k_ref, v_ref, o_ref,
                    m_ref, l_ref, acc_ref, *, block_s: int):
     b = pl.program_id(0)
     s = pl.program_id(2)
@@ -63,9 +63,21 @@ def _decode_kernel(nvalid_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(s == 0)
     def _init():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+        # Initialize the online softmax from the SELF column (the new
+        # token attending itself): m = q·k_new, l = 1, acc = v_new. The
+        # cache is STALE — the current token's K/V never touched HBM; its
+        # contribution lives entirely in registers here (deferred-insert
+        # decode protocol, models/llama.py forward()).
+        q = q_ref[0, 0].astype(jnp.float32)            # [G, Dh]
+        kn = kn_ref[0, 0].astype(jnp.float32)          # [1, Dh]
+        vn = vn_ref[0, 0].astype(jnp.float32)          # [1, Dh]
+        self_s = jax.lax.dot_general(
+            q, kn, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [G, 1]
+        self_s *= q.shape[-1] ** -0.5
+        m_ref[:] = jnp.broadcast_to(self_s, m_ref.shape)
+        l_ref[:] = jnp.ones_like(l_ref)
+        acc_ref[:] = jnp.broadcast_to(vn, acc_ref.shape)
 
     n_valid = nvalid_ref[b]
 
@@ -95,20 +107,22 @@ def _decode_kernel(nvalid_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(s == n_sb - 1)
     def _out():
-        l = l_ref[:, :1]
-        o_ref[0, 0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
-                       ).astype(o_ref.dtype)
+        l = l_ref[:, :1]                               # >= 1 (self column)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
 
 
-def flash_decode_attention(q: jax.Array, layer_k: jax.Array,
-                           layer_v: jax.Array, n_valid: jax.Array,
+def flash_decode_attention(q: jax.Array, k_new: jax.Array,
+                           v_new: jax.Array, layer_k: jax.Array,
+                           layer_v: jax.Array, n_stale: jax.Array,
                            *, block_s: int = 128,
                            interpret: bool | None = None) -> jax.Array:
-    """Ragged single-token attention over an (already updated) cache.
+    """Ragged single-token attention over a STALE cache plus the new token.
 
-    q: [B, H, Dh] (RoPE applied); layer_k/v: [B, KV, S, Dh] (head-major);
-    n_valid: [B] int32 — visible prefix per slot (query position + 1).
-    Returns [B, H * Dh] in q.dtype.
+    q: [B, H, Dh] (RoPE applied); k_new/v_new: [B, KV, Dh] — the current
+    token's key/value (NOT yet in the cache; folded in as the online
+    softmax's initial state); layer_k/v: [B, KV, S, Dh] (head-major);
+    n_stale: [B] int32 — visible stale prefix per slot (the query's
+    position; 0 for a fresh slot). Returns [B, H * Dh] in q.dtype.
     """
     B, H, Dh = q.shape
     KV, S = layer_k.shape[1], layer_k.shape[2]
@@ -120,10 +134,11 @@ def flash_decode_attention(q: jax.Array, layer_k: jax.Array,
     grid = (B, KV, S // block_s)
 
     def kv_index(b, h, s, nv):
-        # Clamp to the slot's last live block: iterations past n_valid re-
+        # Clamp to the slot's last live block: iterations past n_stale re-
         # reference the previous block, so the pipeline elides their DMA
-        # (pl.when already skips their compute). n_valid >= 1 always.
-        last = (nv[b] + block_s - 1) // block_s - 1
+        # (pl.when already skips their compute). max() guards n_stale == 0
+        # (fresh slot: all cache blocks dead, only the self column counts).
+        last = jnp.maximum((nv[b] + block_s - 1) // block_s - 1, 0)
         return b, h, jnp.minimum(s, last), 0
 
     out = pl.pallas_call(
@@ -133,6 +148,8 @@ def flash_decode_attention(q: jax.Array, layer_k: jax.Array,
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, 1, G, Dh), lambda b, h, s, nv: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, 1, Dh), lambda b, h, s, nv: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, 1, Dh), lambda b, h, s, nv: (b, h, 0, 0)),
                 pl.BlockSpec((1, 1, block_s, Dh), kv_index),
                 pl.BlockSpec((1, 1, block_s, Dh), kv_index),
             ],
@@ -146,7 +163,8 @@ def flash_decode_attention(q: jax.Array, layer_k: jax.Array,
         ),
         out_shape=jax.ShapeDtypeStruct((B, KV, G, Dh), q.dtype),
         interpret=_interpret_default() if interpret is None else interpret,
-    )(n_valid.astype(jnp.int32), qg, layer_k, layer_v)
+    )(n_stale.astype(jnp.int32), qg, k_new[:, :, None, :],
+      v_new[:, :, None, :], layer_k, layer_v)
     return out.reshape(B, H * Dh)
 
 
@@ -278,8 +296,11 @@ def make_cache_attention_fn(block_s: int | None = None,
                             block_t: int | None = None,
                             interpret: bool | None = None):
     """Build an ``attention_fn`` (llama.py forward contract) backed by the
-    flash kernels: insert in XLA, attend in Pallas. Decode (T==1) takes the
-    GQA-grouped ragged kernel; prefill chunks take the causal kernel.
+    flash kernels. Prefill chunks (T>1): insert in XLA, attend with the
+    causal kernel. Decode (T==1): the deferred protocol — ``.decode``
+    attends the stale cache + self column in the ragged GQA kernel and
+    ``.insert_all`` (models/llama.py insert_kv_stacked) writes every
+    layer's token once per step, outside the layer scan.
     ``block_s``/``block_t`` default to auto (largest pow2 divisor ≤128)."""
     def attention_fn(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
         B, T, H, Dh = q.shape
@@ -288,19 +309,29 @@ def make_cache_attention_fn(block_s: int | None = None,
         bs = block_s if block_s is not None else _auto_block(S, 128)
         layer_k, layer_v = insert_kv(layer_k, layer_v, k_new, v_new,
                                      lengths, active)
-        if T == 1:
-            n_valid = lengths + 1
-            if active is not None:
-                n_valid = jnp.where(active, n_valid, 1)
-            out = flash_decode_attention(
-                q[:, 0], layer_k, layer_v, n_valid,
-                block_s=bs, interpret=interpret)
-            return out[:, None, :], layer_k, layer_v
         bt = block_t if block_t is not None else _auto_block(T, 128)
         out = flash_prefill_attention(
             q, layer_k, layer_v, lengths,
             block_t=bt, block_s=bs, interpret=interpret)
         return out, layer_k, layer_v
+
+    def decode(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
+        S = layer_k.shape[2]
+        # Decode blocks default wider than prefill (256 vs 128): the grid
+        # is (B, KV, S/bs) programs whose per-program work is one small
+        # matmul — at bs=128 the launch/DMA overhead of 256 tiny programs
+        # dominates; bs=256 measured fastest on v5e (tools/profile_decode
+        # sweep: 3.0 ms/step vs 3.3 at 128, 4.1 at 512 for TinyLlama).
+        bs = block_s if block_s is not None else _auto_block(S, 256)
+        n_stale = lengths if active is None else jnp.where(active, lengths, 0)
+        out = flash_decode_attention(
+            q[:, 0], k_new[:, 0], v_new[:, 0], layer_k, layer_v, n_stale,
+            block_s=bs, interpret=interpret)
+        return out[:, None, :]
+
+    from ..models.llama import insert_kv_stacked
+    attention_fn.decode = decode
+    attention_fn.insert_all = insert_kv_stacked
     return attention_fn
 
 
@@ -322,7 +353,7 @@ def make_sharded_cache_attention_fn(mesh, block_s: int | None = None,
 
     base = make_cache_attention_fn(block_s, block_t, interpret)
 
-    def attention_fn(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
+    def _axes(q, layer_k):
         B, _, H, _ = q.shape
         KV = layer_k.shape[1]
         msize = mesh.shape.get("model", 1)
@@ -330,7 +361,10 @@ def make_sharded_cache_attention_fn(mesh, block_s: int | None = None,
         model = "model" if (msize > 1 and KV % msize == 0 and H % msize == 0) \
             else None
         data = "data" if (dsize > 1 and B % dsize == 0) else None
-        manual = {ax for ax in (model, data) if ax}
+        return model, data, {ax for ax in (model, data) if ax}
+
+    def attention_fn(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
+        model, data, manual = _axes(q, layer_k)
         if not manual:
             return base(q, k_new, v_new, layer_k, layer_v, lengths, active)
 
@@ -340,7 +374,7 @@ def make_sharded_cache_attention_fn(mesh, block_s: int | None = None,
         # `active=None` means "all slots live" — materialize it so the
         # shard_map signature is static.
         act = active if active is not None \
-            else jnp.ones((B,), bool)
+            else jnp.ones((q.shape[0],), bool)
         f = jax.shard_map(
             lambda q_, kn, vn, lk, lv, ln, ac:
                 base(q_, kn, vn, lk, lv, ln, ac),
@@ -349,4 +383,30 @@ def make_sharded_cache_attention_fn(mesh, block_s: int | None = None,
             out_specs=(P(data, None, model), cache, cache),
             axis_names=manual, check_vma=False)
         return f(q, k_new, v_new, layer_k, layer_v, lengths, act)
+
+    def decode(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
+        model, data, manual = _axes(q, layer_k)
+        if not manual:
+            return base.decode(q, k_new, v_new, layer_k, layer_v, lengths,
+                               active)
+        head = P(data, None, model, None)
+        cache = P(data, model, None, None)
+        slot = P(data)
+        act = active if active is not None \
+            else jnp.ones((q.shape[0],), bool)
+        f = jax.shard_map(
+            lambda q_, kn, vn, lk, lv, ln, ac:
+                base.decode(q_, kn, vn, lk, lv, ln, ac),
+            mesh=mesh,
+            in_specs=(head, head, head, cache, cache, slot, slot),
+            out_specs=P(data, None, model),
+            axis_names=manual, check_vma=False)
+        return f(q, k_new, v_new, layer_k, layer_v, lengths, act)
+
+    from ..models.llama import insert_kv_stacked
+    attention_fn.decode = decode
+    # The stacked insert stays in GSPMD land: dynamic_update_slice with
+    # replicated offsets partitions cleanly over the cache's data/model
+    # sharded dims, and it runs ONCE per step outside the layer scan.
+    attention_fn.insert_all = insert_kv_stacked
     return attention_fn

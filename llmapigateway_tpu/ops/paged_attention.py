@@ -103,11 +103,46 @@ def paged_insert_kv(layer_k: jax.Array, layer_v: jax.Array,
     return layer_k, layer_v
 
 
+def paged_insert_all(pool_k: jax.Array, pool_v: jax.Array,
+                     k_news: jax.Array, v_news: jax.Array,
+                     page_table: jax.Array, lengths: jax.Array,
+                     active: jax.Array | None
+                     ) -> tuple[jax.Array, jax.Array]:
+    """Insert every layer's ONE new decode token into the page pool with a
+    single scatter (the paged half of the deferred-insert protocol —
+    models/llama.py ``insert_kv_stacked`` is the dense twin).
+
+    pool_k/v: [L, P, KV, page, Dh]; k_news/v_news: [L, B, 1, KV, Dh] (the
+    layer scan's stacked ys); lengths: [B] — the token's logical position.
+    Masked/overflow writes land on trash page 0 as usual.
+    """
+    L, P, KV, page, Dh = pool_k.shape
+    B = k_news.shape[1]
+    NP = page_table.shape[1]
+
+    logical = jnp.clip(lengths // page, 0, NP - 1)                 # [B]
+    phys = jnp.take_along_axis(page_table, logical[:, None], axis=1)[:, 0]
+    ok = (lengths // page) < NP
+    if active is not None:
+        ok = ok & active
+    phys = jnp.where(ok, phys, 0)
+    off = lengths % page
+
+    newk = k_news[:, :, 0].transpose(1, 0, 2, 3).astype(pool_k.dtype)
+    newv = v_news[:, :, 0].transpose(1, 0, 2, 3).astype(pool_v.dtype)
+    # Advanced indices (phys, off) are separated by slices, so the indexed
+    # result is [B, L, KV, Dh] — newk/newv match that layout.
+    pool_k = pool_k.at[:, phys, :, off].set(newk)
+    pool_v = pool_v.at[:, phys, :, off].set(newv)
+    return pool_k, pool_v
+
+
 # ---------------------------------------------------------------------------
 # Decode kernel: q [B, KV, G, Dh] vs pages [P, KV, page, Dh]
 # ---------------------------------------------------------------------------
 
-def _paged_decode_kernel(pt_ref, nvalid_ref, q_ref, k_ref, v_ref, o_ref,
+def _paged_decode_kernel(pt_ref, nvalid_ref, q_ref, kn_ref, vn_ref,
+                         k_ref, v_ref, o_ref,
                          m_ref, l_ref, acc_ref, *, page: int):
     b = pl.program_id(0)
     j = pl.program_id(2)
@@ -115,9 +150,19 @@ def _paged_decode_kernel(pt_ref, nvalid_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(j == 0)
     def _init():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
+        # Self-column init (deferred-insert decode, see
+        # ops/flash_attention.py _decode_kernel): m = q·k_new, l = 1,
+        # acc = v_new. The pool is stale; the current token never hits HBM.
+        q = q_ref[0, 0].astype(jnp.float32)            # [G, Dh]
+        kn = kn_ref[0, 0].astype(jnp.float32)          # [1, Dh]
+        vn = vn_ref[0, 0].astype(jnp.float32)          # [1, Dh]
+        self_s = jax.lax.dot_general(
+            q, kn, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)        # [G, 1]
+        self_s *= q.shape[-1] ** -0.5
+        m_ref[:] = jnp.broadcast_to(self_s, m_ref.shape)
+        l_ref[:] = jnp.ones_like(l_ref)
+        acc_ref[:] = jnp.broadcast_to(vn, acc_ref.shape)
 
     n_valid = nvalid_ref[b]
 
@@ -146,19 +191,22 @@ def _paged_decode_kernel(pt_ref, nvalid_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(j == n_pb - 1)
     def _out():
-        l = l_ref[:, :1]
-        o_ref[0, 0] = (acc_ref[:] / jnp.where(l == 0.0, 1.0, l)
-                       ).astype(o_ref.dtype)
+        l = l_ref[:, :1]                               # >= 1 (self column)
+        o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
 
 
-def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
+def paged_decode_attention(q: jax.Array, k_new: jax.Array,
+                           v_new: jax.Array, k_pages: jax.Array,
                            v_pages: jax.Array, page_table: jax.Array,
-                           n_valid: jax.Array, *,
+                           n_stale: jax.Array, *,
                            interpret: bool | None = None) -> jax.Array:
-    """Ragged single-token attention over the page pool.
+    """Ragged single-token attention over the STALE page pool plus the new
+    token (self column folded into the online-softmax init).
 
-    q: [B, H, Dh] (RoPE applied); k_pages/v_pages: [P, KV, page, Dh];
-    page_table: [B, NP]; n_valid: [B] int32 (≥1). Returns [B, H*Dh].
+    q: [B, H, Dh] (RoPE applied); k_new/v_new: [B, KV, Dh];
+    k_pages/v_pages: [P, KV, page, Dh]; page_table: [B, NP];
+    n_stale: [B] int32 (the query's position; 0 for a fresh slot).
+    Returns [B, H*Dh].
     """
     B, H, Dh = q.shape
     KV, page = k_pages.shape[1], k_pages.shape[2]
@@ -179,6 +227,10 @@ def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
             in_specs=[
                 pl.BlockSpec((1, 1, G, Dh),
                              lambda b, h, j, pt, nv: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, 1, Dh),
+                             lambda b, h, j, pt, nv: (b, h, 0, 0)),
+                pl.BlockSpec((1, 1, 1, Dh),
+                             lambda b, h, j, pt, nv: (b, h, 0, 0)),
                 pl.BlockSpec((1, 1, page, Dh), kv_index),
                 pl.BlockSpec((1, 1, page, Dh), kv_index),
             ],
@@ -192,8 +244,8 @@ def paged_decode_attention(q: jax.Array, k_pages: jax.Array,
         ),
         out_shape=jax.ShapeDtypeStruct((B, KV, G, Dh), q.dtype),
         interpret=_interpret_default() if interpret is None else interpret,
-    )(page_table.astype(jnp.int32), n_valid.astype(jnp.int32),
-      qg, k_pages, v_pages)
+    )(page_table.astype(jnp.int32), n_stale.astype(jnp.int32),
+      qg, k_new[:, :, None, :], v_new[:, :, None, :], k_pages, v_pages)
     return out.reshape(B, H * Dh)
 
 
@@ -374,25 +426,6 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
             return out, layer_k, layer_v
         shard = msize > 1 and KV % msize == 0 and H % msize == 0
         pool = P(None, "model", None, None)
-        if T == 1:
-            n_valid = lengths + 1
-            if active is not None:
-                n_valid = jnp.where(active, n_valid, 1)
-            if shard:
-                f = jax.shard_map(
-                    lambda q_, k_, v_, pt_, nv_: paged_decode_attention(
-                        q_, k_, v_, pt_, nv_, interpret=interpret),
-                    mesh=mesh,
-                    in_specs=(P(None, "model", None), pool, pool,
-                              P(None, None), P(None)),
-                    out_specs=P(None, "model"),
-                    axis_names={"model"}, check_vma=False)
-                out = f(q[:, 0], layer_k, layer_v, page_table, n_valid)
-            else:
-                out = paged_decode_attention(
-                    q[:, 0], layer_k, layer_v, page_table, n_valid,
-                    interpret=interpret)
-            return out[:, None, :], layer_k, layer_v
         bt = block_t if block_t is not None else min(T & (-T), 128)
         if shard:
             f = jax.shard_map(
@@ -409,4 +442,42 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
                 q, layer_k, layer_v, page_table, lengths,
                 block_t=bt, interpret=interpret)
         return out, layer_k, layer_v
+
+    def decode(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
+        """Deferred-decode: stale pool + self column, no insert."""
+        B, T, H, Dh = q.shape
+        KV = layer_k.shape[1]
+        n_stale = lengths if active is None else jnp.where(active, lengths, 0)
+        if impl == "reference":
+            from ..models.llama import dense_decode_attention
+            dense_k = gather_pages(layer_k, page_table, max_seq)
+            dense_v = gather_pages(layer_v, page_table, max_seq)
+            return dense_decode_attention(q, k_new, v_new, dense_k, dense_v,
+                                          n_stale, None)
+        shard = msize > 1 and KV % msize == 0 and H % msize == 0
+        pool = P(None, "model", None, None)
+        if shard:
+            f = jax.shard_map(
+                lambda q_, kn_, vn_, k_, v_, pt_, nv_: paged_decode_attention(
+                    q_, kn_, vn_, k_, v_, pt_, nv_, interpret=interpret),
+                mesh=mesh,
+                in_specs=(P(None, "model", None), P(None, "model", None),
+                          P(None, "model", None), pool, pool,
+                          P(None, None), P(None)),
+                out_specs=P(None, "model"),
+                axis_names={"model"}, check_vma=False)
+            out = f(q[:, 0], k_new[:, 0], v_new[:, 0], layer_k, layer_v,
+                    page_table, n_stale)
+        else:
+            out = paged_decode_attention(
+                q[:, 0], k_new[:, 0], v_new[:, 0], layer_k, layer_v,
+                page_table, n_stale, interpret=interpret)
+        return out[:, None, :]
+
+    def insert_all(pool_k, pool_v, k_news, v_news, lengths, active):
+        return paged_insert_all(pool_k, pool_v, k_news, v_news,
+                                page_table, lengths, active)
+
+    attention_fn.decode = decode
+    attention_fn.insert_all = insert_all
     return attention_fn

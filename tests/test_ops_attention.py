@@ -30,17 +30,21 @@ def _mk(B, S, T, H, KV, Dh, seed=0):
     (1, 256, 4, 1, 64, 64),     # MQA-ish: 1 KV head
 ])
 def test_decode_kernel_matches_reference(B, S, H, KV, Dh, block_s):
+    """The deferred-decode pallas path (.decode + .insert_all, the exact
+    calls llama.forward makes for T==1) vs insert-then-attend reference."""
     q, k_new, v_new, layer_k, layer_v = _mk(B, S, 1, H, KV, Dh)
     lengths = jnp.asarray(np.random.default_rng(0).integers(0, S - 1, B),
                           jnp.int32)
     ref, ref_k, ref_v = dense_cache_attention(
         q, k_new, v_new, layer_k, layer_v, lengths)
     attn = make_cache_attention_fn(block_s=block_s, interpret=True)
-    got, got_k, got_v = attn(q, k_new, v_new, layer_k, layer_v, lengths)
+    got = attn.decode(q, k_new, v_new, layer_k, layer_v, lengths)
+    got_k, got_v = attn.insert_all(layer_k[None], layer_v[None],
+                                   k_new[None], v_new[None], lengths, None)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
-    np.testing.assert_allclose(np.asarray(got_k), np.asarray(ref_k))
-    np.testing.assert_allclose(np.asarray(got_v), np.asarray(ref_v))
+    np.testing.assert_allclose(np.asarray(got_k[0]), np.asarray(ref_k))
+    np.testing.assert_allclose(np.asarray(got_v[0]), np.asarray(ref_v))
 
 
 def test_decode_kernel_respects_active_mask():
@@ -51,11 +55,12 @@ def test_decode_kernel_respects_active_mask():
     ref, ref_k, ref_v = dense_cache_attention(
         q, k_new, v_new, layer_k, layer_v, lengths, active)
     attn = make_cache_attention_fn(block_s=32, interpret=True)
-    got, got_k, got_v = attn(q, k_new, v_new, layer_k, layer_v, lengths,
-                             active)
-    # Inactive rows' cache must be untouched.
-    np.testing.assert_allclose(np.asarray(got_k), np.asarray(ref_k))
-    np.testing.assert_allclose(np.asarray(got_v), np.asarray(ref_v))
+    got = attn.decode(q, k_new, v_new, layer_k, layer_v, lengths, active)
+    got_k, got_v = attn.insert_all(layer_k[None], layer_v[None],
+                                   k_new[None], v_new[None], lengths, active)
+    # Inactive rows' cache must be untouched (same tail-clamp as insert_kv).
+    np.testing.assert_allclose(np.asarray(got_k[0]), np.asarray(ref_k))
+    np.testing.assert_allclose(np.asarray(got_v[0]), np.asarray(ref_v))
     act = np.asarray(active)
     np.testing.assert_allclose(np.asarray(got)[act], np.asarray(ref)[act],
                                rtol=2e-5, atol=2e-5)
@@ -172,8 +177,12 @@ def test_sharded_attention_matches_reference_on_mesh():
                 jax.device_put(v_new, head), jax.device_put(layer_k, cache),
                 jax.device_put(layer_v, cache), jax.device_put(lengths, slot))
         if t == 1:
-            got, got_k, got_v = jax.jit(attn)(
-                *args, jax.device_put(active, slot))
+            # The deferred-decode path, exactly as llama.forward drives it.
+            got = jax.jit(attn.decode)(*args, jax.device_put(active, slot))
+            got_k, got_v = jax.jit(attn.insert_all)(
+                args[3][None], args[4][None], args[1][None], args[2][None],
+                args[5], jax.device_put(active, slot))
+            got_k, got_v = got_k[0], got_v[0]
         else:
             got, got_k, got_v = jax.jit(
                 lambda *a: attn(*a))(*args)

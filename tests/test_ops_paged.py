@@ -84,19 +84,27 @@ def test_paged_insert_matches_dense_insert():
     (1, 256, 4, 1, 64, 32),    # MQA-ish
 ])
 def test_paged_decode_matches_dense(impl, B, S, H, KV, Dh, page):
+    """Deferred-decode over the paged pool (.decode + .insert_all — the
+    exact calls llama.forward makes for T==1) vs the dense reference."""
     (q, k_new, v_new, dense_k, dense_v, pk, pv, table) = _setup(
         B, S, 1, H, KV, Dh, page, seed=2)
     lengths = jnp.asarray(
         np.random.default_rng(0).integers(0, S - 1, B), jnp.int32)
     active = jnp.ones((B,), bool)
 
-    ref, _, _ = dense_cache_attention(q, k_new, v_new, dense_k, dense_v,
-                                      lengths, active)
+    ref, ref_k, ref_v = dense_cache_attention(
+        q, k_new, v_new, dense_k, dense_v, lengths, active)
     attn = make_paged_attention_fn(table, max_seq=S, impl=impl,
                                    interpret=True)
-    got, _, _ = attn(q, k_new, v_new, pk, pv, lengths, active)
+    got = attn.decode(q, k_new, v_new, pk, pv, lengths, active)
     np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
                                rtol=2e-5, atol=2e-5)
+    got_pk, got_pv = attn.insert_all(pk[None], pv[None], k_new[None],
+                                     v_new[None], lengths, active)
+    got_k = gather_pages(got_pk[0], table, S)
+    got_v = gather_pages(got_pv[0], table, S)
+    np.testing.assert_allclose(np.asarray(got_k), np.asarray(ref_k))
+    np.testing.assert_allclose(np.asarray(got_v), np.asarray(ref_v))
 
 
 @pytest.mark.parametrize("impl", ["reference", "pallas"])

@@ -61,8 +61,14 @@ def test_pipeline_decode_step_with_inactive_rows(setup):
     active = jnp.asarray([True, True, False, True])
     ref_logits, ref_cache, got_logits, got_cache = _run_pair(
         cfg, params, mesh, B, T, M, lengths, active=active)
-    np.testing.assert_allclose(np.asarray(got_logits),
-                               np.asarray(ref_logits), rtol=1e-5, atol=1e-5)
+    # Inactive rows' logits are explicitly meaningless (the scheduler
+    # discards them): the sequential path now attends self-only for them
+    # (deferred-decode), the pipelined path averages a fully-masked
+    # softmax — different garbage. Compare the rows that matter.
+    act = np.asarray(active)
+    np.testing.assert_allclose(np.asarray(got_logits)[act],
+                               np.asarray(ref_logits)[act],
+                               rtol=1e-5, atol=1e-5)
     # Visible cache region matches per active row (up to its new length).
     for b, (ln, act) in enumerate(zip([3, 5, 0, 7], [1, 1, 0, 1])):
         upto = ln + act
