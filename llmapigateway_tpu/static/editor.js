@@ -113,6 +113,51 @@ function json5Check(text) {
   return null;
 }
 
+/* ---------------- JSON5 syntax highlighter (overlay) ----------------
+   Tokenizes the buffer into HTML spans rendered in a <pre> positioned
+   behind the transparent-text textarea — caret/selection/undo stay native
+   to the textarea while colors come from the overlay. One master regex,
+   alternatives ordered: comments first (so strings inside comments don't
+   tokenize), then strings (key vs value decided by a ':' lookahead),
+   numbers, keywords, punctuation. */
+const TOKEN_RE = new RegExp(
+  [
+    "(\\/\\/[^\\n]*|\\/\\*[\\s\\S]*?\\*\\/)",                 // 1 comment
+    "(\"(?:\\\\.|[^\"\\\\\\n])*\"?|'(?:\\\\.|[^'\\\\\\n])*'?)", // 2 string
+    "([+-]?(?:Infinity|NaN|0x[0-9a-fA-F]+|(?:\\d+\\.?\\d*|\\.\\d+)(?:[eE][+-]?\\d+)?))", // 3 number
+    "\\b(true|false|null)\\b",                                  // 4 keyword
+    "([{}\\[\\],:])",                                           // 5 punct
+  ].join("|"), "g");
+
+function escapeHtml(s) {
+  return s.replace(/&/g, "&amp;").replace(/</g, "&lt;").replace(/>/g, "&gt;");
+}
+
+// Sticky (O(1), no buffer copy) "is the next non-space char a ':'" probe —
+// decides string-token key-vs-value without slicing the document tail per
+// token (which would make every keystroke's re-highlight O(n^2)).
+const COLON_AHEAD = /\s*:/y;
+
+function highlightJson5(text) {
+  let out = "";
+  let last = 0;
+  TOKEN_RE.lastIndex = 0;
+  for (let m; (m = TOKEN_RE.exec(text)); ) {
+    out += escapeHtml(text.slice(last, m.index));
+    last = m.index + m[0].length;
+    let cls = "tok-punct";
+    if (m[1] !== undefined) cls = "tok-comment";
+    else if (m[2] !== undefined) {
+      COLON_AHEAD.lastIndex = last;
+      cls = COLON_AHEAD.test(text) ? "tok-key" : "tok-string";
+    } else if (m[3] !== undefined) cls = "tok-number";
+    else if (m[4] !== undefined) cls = "tok-keyword";
+    out += `<span class="${cls}">${escapeHtml(m[0])}</span>`;
+  }
+  out += escapeHtml(text.slice(last));
+  return out;
+}
+
 /* ---------------- helpers ---------------- */
 const $ = (id) => document.getElementById(id);
 
@@ -128,14 +173,18 @@ function setStatus(el, text, cls) {
 }
 
 /* ---------------- theme + key persistence ---------------- */
-if (localStorage.getItem("gw-theme") === "dark") {
-  document.body.classList.add("dark");
+const THEMES = ["light", "dark", "solarized", "midnight", "contrast"];
+function applyTheme(name) {
+  if (!THEMES.includes(name)) name = "light";
+  document.body.dataset.theme = name;
+  document.body.classList.toggle("dark",
+    name === "dark" || name === "midnight");   // back-compat for page chrome
+  $("theme-select").value = name;
+  localStorage.setItem("gw-theme", name);
 }
-$("theme-toggle").addEventListener("click", () => {
-  document.body.classList.toggle("dark");
-  localStorage.setItem(
-    "gw-theme", document.body.classList.contains("dark") ? "dark" : "light");
-});
+applyTheme(localStorage.getItem("gw-theme") || "light");
+$("theme-select").addEventListener("change",
+  (ev) => applyTheme(ev.target.value));
 $("api-key").value = localStorage.getItem("gw-api-key") || "";
 $("api-key").addEventListener("change", () => {
   localStorage.setItem("gw-api-key", apiKey());
@@ -157,14 +206,47 @@ const ENDPOINTS = {
   providers: "/v1/config/providers",
 };
 const original = { rules: "", providers: "" };
+const errPos = { rules: null, providers: null };   // {line, col} | null
+const lintTimers = {};
 
 function syncGutter(which) {
   const ta = $("editor-" + which);
   const lines = ta.value.split("\n").length || 1;
   const gutter = $("gutter-" + which);
-  gutter.textContent =
-    Array.from({ length: lines }, (_, k) => k + 1).join("\n");
+  const bad = errPos[which] ? errPos[which].line : 0;
+  gutter.innerHTML = Array.from({ length: lines }, (_, k) =>
+    k + 1 === bad ? `<span class="ln-err">${k + 1}</span>` : String(k + 1)
+  ).join("\n");
   gutter.scrollTop = ta.scrollTop;
+}
+
+function render(which) {
+  const ta = $("editor-" + which);
+  // Trailing newline keeps the overlay's scrollHeight matching the
+  // textarea's when the caret sits on a fresh last line.
+  $("hl-" + which).innerHTML = highlightJson5(ta.value) + "\n";
+}
+
+function syncScroll(which) {
+  const ta = $("editor-" + which);
+  $("gutter-" + which).scrollTop = ta.scrollTop;
+  const hl = $("hl-" + which);
+  hl.scrollTop = ta.scrollTop;
+  hl.scrollLeft = ta.scrollLeft;
+}
+
+/* Lint-as-you-type: debounced syntax check updating the error box and the
+   gutter's red line marker — the explicit "Check syntax" button stays for
+   a loud pass/fail status. */
+function liveLint(which) {
+  clearTimeout(lintTimers[which]);
+  lintTimers[which] = setTimeout(() => {
+    const e = json5Check($("editor-" + which).value);
+    errPos[which] = e ? { line: e.line, col: e.col } : null;
+    showErrors(which,
+      e ? [`line ${e.line}, col ${e.col}: ${e.message}`] : null);
+    syncGutter(which);
+  }, 250);
 }
 
 function showErrors(which, errors) {
@@ -191,7 +273,10 @@ async function loadFile(which) {
     const text = await resp.text();
     original[which] = text;
     $("editor-" + which).value = text;
+    errPos[which] = null;
     syncGutter(which);
+    render(which);
+    liveLint(which);
     showErrors(which, null);
     setStatus(status, "loaded", "ok");
   } catch (e) {
@@ -202,6 +287,8 @@ async function loadFile(which) {
 function lint(which) {
   const status = $("status-" + which);
   const e = json5Check($("editor-" + which).value);
+  errPos[which] = e ? { line: e.line, col: e.col } : null;
+  syncGutter(which);
   if (e) {
     showErrors(which, [`line ${e.line}, col ${e.col}: ${e.message}`]);
     setStatus(status, "syntax error", "err");
@@ -243,23 +330,42 @@ async function saveFile(which) {
 
 for (const which of ["rules", "providers"]) {
   const ta = $("editor-" + which);
-  ta.addEventListener("input", () => syncGutter(which));
-  ta.addEventListener("scroll", () => {
-    $("gutter-" + which).scrollTop = ta.scrollTop;
+  ta.addEventListener("input", () => {
+    syncGutter(which);
+    render(which);
+    liveLint(which);
   });
+  ta.addEventListener("scroll", () => syncScroll(which));
   ta.addEventListener("keydown", (ev) => {   // Tab inserts two spaces
     if (ev.key === "Tab") {
       ev.preventDefault();
       const s = ta.selectionStart;
       ta.setRangeText("  ", s, ta.selectionEnd, "end");
       syncGutter(which);
+      render(which);
+      liveLint(which);
     }
+  });
+  // Click the error message → jump the caret to the reported position.
+  $("errors-" + which).addEventListener("click", () => {
+    const p = errPos[which];
+    if (!p) return;
+    const lines = ta.value.split("\n");
+    let idx = 0;
+    for (let l = 0; l < p.line - 1 && l < lines.length; l++) {
+      idx += lines[l].length + 1;
+    }
+    idx += Math.max(0, p.col - 1);
+    ta.focus();
+    ta.setSelectionRange(idx, idx);
   });
   $("save-" + which).addEventListener("click", () => saveFile(which));
   $("lint-" + which).addEventListener("click", () => lint(which));
   $("revert-" + which).addEventListener("click", () => {
     ta.value = original[which];
+    errPos[which] = null;
     syncGutter(which);
+    render(which);
     showErrors(which, null);
     setStatus($("status-" + which), "reverted", "ok");
   });

@@ -14,8 +14,13 @@ function authHeaders() {
   return k ? { Authorization: "Bearer " + k } : {};
 }
 
-/* theme + key persistence (shared localStorage keys with the editor) */
-if (localStorage.getItem("gw-theme") === "dark") {
+/* theme + key persistence (shared localStorage keys with the editor).
+   gw-theme carries the editor's 5 theme names; this page only has
+   light/dark chrome, so map dark-family themes to dark and NEVER write
+   the key back except from an explicit toggle here — a plain page load
+   must not clobber a richer saved editor theme. */
+const DARK_THEMES = ["dark", "midnight", "contrast"];
+if (DARK_THEMES.includes(localStorage.getItem("gw-theme"))) {
   document.body.classList.add("dark");
 }
 $("theme-toggle").addEventListener("click", () => {
