@@ -60,6 +60,13 @@ _LLAMA_MAP: list[tuple[re.Pattern, str, bool]] = [
      "layers.wo.{i}", True),
     (re.compile(r"^model\.layers\.(\d+)\.post_attention_layernorm\.weight$"),
      "layers.mlp_norm.{i}", False),
+    # Qwen2 QKV bias (1-D: no transpose)
+    (re.compile(r"^model\.layers\.(\d+)\.self_attn\.q_proj\.bias$"),
+     "layers.bq.{i}", False),
+    (re.compile(r"^model\.layers\.(\d+)\.self_attn\.k_proj\.bias$"),
+     "layers.bk.{i}", False),
+    (re.compile(r"^model\.layers\.(\d+)\.self_attn\.v_proj\.bias$"),
+     "layers.bv.{i}", False),
     (re.compile(r"^model\.layers\.(\d+)\.mlp\.gate_proj\.weight$"),
      "layers.wg.{i}", True),
     (re.compile(r"^model\.layers\.(\d+)\.mlp\.up_proj\.weight$"),
@@ -191,6 +198,10 @@ def _validate_shapes(params: dict[str, Any], config: ModelConfig) -> None:
     lk = params["layers"]
     required = {"attn_norm", "wq", "wk", "wv", "wo", "mlp_norm"}
     required |= {"router"} if c.is_moe else {"wg", "wu", "wd"}
+    if c.attn_bias:
+        # A qwen2-family checkpoint with missing/unmapped bias tensors must
+        # refuse to load, not silently run bias-free.
+        required |= {"bq", "bk", "bv"}
     missing = required - set(lk)
     if missing:
         raise ValueError(f"checkpoint is missing layer params {sorted(missing)}; "

@@ -1303,6 +1303,8 @@ def _config_from_checkpoint(model_path: str) -> ModelConfig:
                            n_experts=cfg.get("num_local_experts", 8),
                            experts_per_token=cfg.get("num_experts_per_tok", 2),
                            **common)
+    if mtype == "qwen2":
+        return ModelConfig(family="qwen2", attn_bias=True, **common)
     return ModelConfig(family="llama", **common)
 
 

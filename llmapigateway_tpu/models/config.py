@@ -34,7 +34,7 @@ class RopeScaling:
 
 @dataclass(frozen=True)
 class ModelConfig:
-    family: str = "llama"          # "llama" | "mixtral"
+    family: str = "llama"          # "llama" | "qwen2" | "mixtral"
     vocab_size: int = 32000
     d_model: int = 2048
     n_layers: int = 22
@@ -46,6 +46,8 @@ class ModelConfig:
     rms_eps: float = 1e-5
     max_seq_len: int = 4096
     tie_embeddings: bool = False
+    # QKV projection bias (Qwen2-family); the rest of the block is llama.
+    attn_bias: bool = False
     # MoE (mixtral) fields
     n_experts: int = 0             # 0 → dense
     experts_per_token: int = 2
@@ -64,6 +66,10 @@ PRESETS: dict[str, ModelConfig] = {
     "tiny-test": ModelConfig(
         vocab_size=512, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
         d_ff=128, max_seq_len=256),
+    "tiny-qwen-test": ModelConfig(
+        family="qwen2", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+        n_kv_heads=2, d_ff=128, max_seq_len=256, tie_embeddings=True,
+        attn_bias=True),
     "tiny-moe-test": ModelConfig(
         family="mixtral", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
         n_kv_heads=2, d_ff=128, max_seq_len=256, n_experts=4,
@@ -72,6 +78,13 @@ PRESETS: dict[str, ModelConfig] = {
     "tinyllama-1.1b": ModelConfig(
         vocab_size=32000, d_model=2048, n_layers=22, n_heads=32, n_kv_heads=4,
         d_ff=5632, rope_theta=10000.0, max_seq_len=2048),
+    # Qwen2-0.5B (HF: Qwen/Qwen2-0.5B-Instruct) — llama block + QKV bias,
+    # tied embeddings.
+    "qwen2-0.5b": ModelConfig(
+        family="qwen2", vocab_size=151936, d_model=896, n_layers=24,
+        n_heads=14, n_kv_heads=2, d_ff=4864, rope_theta=1000000.0,
+        rms_eps=1e-6, max_seq_len=32768, tie_embeddings=True,
+        attn_bias=True),
     # ~3B-class llama geometry (TPU-friendly head_dim=128, GQA 24/8):
     # ~3.2B params ≈ 6.4 GB bf16 — the largest preset that comfortably
     # fits one 16 GB v5e chip with a bs=8 KV cache. The bench ladder's mid

@@ -71,6 +71,16 @@ def init_params(config: ModelConfig, key: jax.Array,
             "wd": dense_init(keys[7], c.n_layers, c.d_ff, c.d_model),
         },
     }
+    if c.attn_bias:
+        # Qwen2-family QKV bias. Random (not zero) init so random-weight
+        # tests exercise the bias path end to end.
+        bkeys = jax.random.split(keys[9], 3)
+        params["layers"]["bq"] = dense_init(
+            bkeys[0], c.n_layers, c.n_heads * dh)
+        params["layers"]["bk"] = dense_init(
+            bkeys[1], c.n_layers, c.n_kv_heads * dh)
+        params["layers"]["bv"] = dense_init(
+            bkeys[2], c.n_layers, c.n_kv_heads * dh)
     if not c.tie_embeddings:
         params["lm_head"] = dense_init(keys[8], c.vocab_size, c.d_model)
     return params
@@ -360,11 +370,15 @@ def forward(params: Params, config: ModelConfig, tokens: jax.Array,
 
     def layer_step(x, scanned):
         lp, layer_k, layer_v = scanned
-        # Attention block
+        # Attention block ("bq" in lp is static at trace time — qwen2's
+        # QKV bias, absent for plain llama layouts).
         h = rms_norm(x, lp["attn_norm"], c.rms_eps)
-        q = (h @ lp["wq"]).reshape(B, T, c.n_heads, dh)
-        k = (h @ lp["wk"]).reshape(B, T, c.n_kv_heads, dh)
-        v = (h @ lp["wv"]).reshape(B, T, c.n_kv_heads, dh)
+        qp, kp, vp = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+        if "bq" in lp:
+            qp, kp, vp = qp + lp["bq"], kp + lp["bk"], vp + lp["bv"]
+        q = qp.reshape(B, T, c.n_heads, dh)
+        k = kp.reshape(B, T, c.n_kv_heads, dh)
+        v = vp.reshape(B, T, c.n_kv_heads, dh)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         if decode_attend is not None:

@@ -64,9 +64,12 @@ def _block_forward(lp_block: dict, c: ModelConfig, x: jax.Array,
     def layer_step(x, scanned):
         lp, layer_k, layer_v = scanned
         h = llama.rms_norm(x, lp["attn_norm"], c.rms_eps)
-        q = (h @ lp["wq"]).reshape(B, T, c.n_heads, dh)
-        k = (h @ lp["wk"]).reshape(B, T, c.n_kv_heads, dh)
-        v = (h @ lp["wv"]).reshape(B, T, c.n_kv_heads, dh)
+        qp, kp, vp = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+        if "bq" in lp:                    # qwen2-family QKV bias
+            qp, kp, vp = qp + lp["bq"], kp + lp["bk"], vp + lp["bv"]
+        q = qp.reshape(B, T, c.n_heads, dh)
+        k = kp.reshape(B, T, c.n_kv_heads, dh)
+        v = vp.reshape(B, T, c.n_kv_heads, dh)
         q = llama.apply_rope(q, cos, sin)
         k = llama.apply_rope(k, cos, sin)
         attn, layer_k, layer_v = llama.dense_cache_attention(

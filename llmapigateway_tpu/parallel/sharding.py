@@ -51,6 +51,8 @@ def _spec_for(path: str, shape: tuple[int, ...], mesh: Mesh) -> P:
             return P(lp, None)
         if key == "router":                       # [L, D, E]
             return P(lp, None, None)
+        if key in ("bq", "bk", "bv"):             # [L, out] column bias
+            return P(lp, _axis(mesh, "model", shape[1]))
         n = len(shape)
         if key in ("wq", "wk", "wv", "wg", "wu"):
             if n == 4:                            # MoE expert: [L, E, D, F]

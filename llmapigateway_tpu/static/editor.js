@@ -235,18 +235,31 @@ function syncScroll(which) {
   hl.scrollLeft = ta.scrollLeft;
 }
 
-/* Lint-as-you-type: debounced syntax check updating the error box and the
-   gutter's red line marker — the explicit "Check syntax" button stays for
-   a loud pass/fail status. */
+/* Shared check-and-mark core: run the syntax checker, update errPos, the
+   error box, and the gutter marker. Every buffer-mutating path goes
+   through checkAndMark (directly or via the debounced liveLint). */
+function checkAndMark(which) {
+  const e = json5Check($("editor-" + which).value);
+  errPos[which] = e ? { line: e.line, col: e.col } : null;
+  showErrors(which,
+    e ? [`line ${e.line}, col ${e.col}: ${e.message}`] : null);
+  syncGutter(which);
+  return e;
+}
+
+/* Lint-as-you-type: debounced — the explicit "Check syntax" button stays
+   for a loud pass/fail status. */
 function liveLint(which) {
   clearTimeout(lintTimers[which]);
-  lintTimers[which] = setTimeout(() => {
-    const e = json5Check($("editor-" + which).value);
-    errPos[which] = e ? { line: e.line, col: e.col } : null;
-    showErrors(which,
-      e ? [`line ${e.line}, col ${e.col}: ${e.message}`] : null);
-    syncGutter(which);
-  }, 250);
+  lintTimers[which] = setTimeout(() => checkAndMark(which), 250);
+}
+
+/* The one entry point after ANY buffer mutation: gutter, overlay, lint. */
+function refresh(which, { immediate = false } = {}) {
+  syncGutter(which);
+  render(which);
+  if (immediate) checkAndMark(which);
+  else liveLint(which);
 }
 
 function showErrors(which, errors) {
@@ -273,11 +286,7 @@ async function loadFile(which) {
     const text = await resp.text();
     original[which] = text;
     $("editor-" + which).value = text;
-    errPos[which] = null;
-    syncGutter(which);
-    render(which);
-    liveLint(which);
-    showErrors(which, null);
+    refresh(which);
     setStatus(status, "loaded", "ok");
   } catch (e) {
     setStatus(status, "load failed: " + e, "err");
@@ -285,18 +294,10 @@ async function loadFile(which) {
 }
 
 function lint(which) {
-  const status = $("status-" + which);
-  const e = json5Check($("editor-" + which).value);
-  errPos[which] = e ? { line: e.line, col: e.col } : null;
-  syncGutter(which);
-  if (e) {
-    showErrors(which, [`line ${e.line}, col ${e.col}: ${e.message}`]);
-    setStatus(status, "syntax error", "err");
-    return false;
-  }
-  showErrors(which, null);
-  setStatus(status, "syntax OK", "ok");
-  return true;
+  const e = checkAndMark(which);
+  setStatus($("status-" + which),
+            e ? "syntax error" : "syntax OK", e ? "err" : "ok");
+  return !e;
 }
 
 async function saveFile(which) {
@@ -330,20 +331,14 @@ async function saveFile(which) {
 
 for (const which of ["rules", "providers"]) {
   const ta = $("editor-" + which);
-  ta.addEventListener("input", () => {
-    syncGutter(which);
-    render(which);
-    liveLint(which);
-  });
+  ta.addEventListener("input", () => refresh(which));
   ta.addEventListener("scroll", () => syncScroll(which));
   ta.addEventListener("keydown", (ev) => {   // Tab inserts two spaces
     if (ev.key === "Tab") {
       ev.preventDefault();
       const s = ta.selectionStart;
       ta.setRangeText("  ", s, ta.selectionEnd, "end");
-      syncGutter(which);
-      render(which);
-      liveLint(which);
+      refresh(which);
     }
   });
   // Click the error message → jump the caret to the reported position.
@@ -363,10 +358,7 @@ for (const which of ["rules", "providers"]) {
   $("lint-" + which).addEventListener("click", () => lint(which));
   $("revert-" + which).addEventListener("click", () => {
     ta.value = original[which];
-    errPos[which] = null;
-    syncGutter(which);
-    render(which);
-    showErrors(which, null);
+    refresh(which, { immediate: true });
     setStatus($("status-" + which), "reverted", "ok");
   });
   loadFile(which);

@@ -114,6 +114,25 @@ async def test_pipelined_slot_reuse_no_token_bleed():
         await eng.stop()
 
 
+async def test_engine_serves_qwen2_family():
+    """Qwen2 (llama block + QKV bias) serves end-to-end through the engine,
+    random-init — exercises bias init/forward in both prefill and the
+    deferred-decode path."""
+    from llmapigateway_tpu.models.config import ModelConfig
+    cfg = ModelConfig(family="qwen2", vocab_size=256, d_model=64, n_layers=2,
+                      n_heads=4, n_kv_heads=2, d_ff=128, max_seq_len=128,
+                      tie_embeddings=True, attn_bias=True)
+    eng = InferenceEngine(
+        LocalEngineConfig(max_batch_size=2, max_seq_len=64, prefill_chunk=16,
+                          dtype="float32"),
+        model_cfg=cfg, devices=[jax.devices("cpu")[0]])
+    try:
+        req = await _generate(eng, "qwen bias", max_tokens=5)
+        assert req.finish_reason is not None and len(req.generated) >= 1
+    finally:
+        await eng.stop()
+
+
 async def test_prompt_too_long_is_overload(engine):
     req = GenRequest(prompt_ids=list(range(4000)), max_tokens=4)
     with pytest.raises(EngineOverloaded):

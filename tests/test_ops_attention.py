@@ -196,6 +196,35 @@ def test_sharded_attention_matches_reference_on_mesh():
                                    rtol=2e-5, atol=2e-5)
 
 
+async def test_engine_tp_mesh_pallas_attention_parity():
+    """VERDICT r2 stretch: the sharded-cache Pallas path must actually
+    engage for a TP mesh in REAL serving (not just the standalone op) and
+    match the reference engine's greedy tokens. interpret-mode on CPU —
+    same shard_map wrapper the TPU path uses."""
+    from llmapigateway_tpu.config.schemas import LocalEngineConfig
+    from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
+
+    async def run(attention, mesh, n_dev):
+        eng = InferenceEngine(LocalEngineConfig(
+            preset="tiny-test", dtype="float32", max_batch_size=2,
+            max_seq_len=64, prefill_chunk=16, attention=attention,
+            mesh=mesh),
+            devices=jax.devices("cpu")[:n_dev])
+        try:
+            req = GenRequest(prompt_ids=[3, 1, 4, 1, 5, 9, 2, 6],
+                             max_tokens=6, temperature=0.0)
+            await eng.submit(req)
+            async for _ in eng.stream(req):
+                pass
+            return req.generated
+        finally:
+            await eng.stop()
+
+    ref = await run("reference", {"model": 2}, 2)
+    got = await run("pallas", {"model": 2}, 2)
+    assert got == ref, (got, ref)
+
+
 def test_sharded_attention_single_slot_prefill_row():
     """The engine's prefill slices a [1, ...] slot row — batch can't shard
     on data, so the wrapper must go manual over model only and still match."""

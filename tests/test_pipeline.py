@@ -52,6 +52,23 @@ def test_pipeline_matches_sequential_prefill(setup, pipe, M):
                                rtol=1e-5, atol=1e-5)
 
 
+def test_pipeline_matches_sequential_with_qwen2_bias():
+    """The staged layer body must apply the qwen2 QKV bias exactly like the
+    sequential forward (regression: the bias was initially added only to
+    models/llama.py's layer_step)."""
+    cfg = ModelConfig(family="qwen2", vocab_size=128, d_model=32, n_layers=4,
+                      n_heads=4, n_kv_heads=2, d_ff=64, max_seq_len=64,
+                      tie_embeddings=True, attn_bias=True)
+    params = llama.init_params(cfg, jax.random.PRNGKey(7), dtype=jnp.float32)
+    mesh = build_mesh(MeshSpec(sizes={"pipe": 2}, auto_model=False),
+                      cpu_devices()[:2])
+    ref_logits, _, got_logits, _ = _run_pair(
+        cfg, params, mesh, B=2, T=8, M=2,
+        lengths=jnp.zeros((2,), jnp.int32))
+    np.testing.assert_allclose(np.asarray(got_logits),
+                               np.asarray(ref_logits), rtol=1e-5, atol=1e-5)
+
+
 def test_pipeline_decode_step_with_inactive_rows(setup):
     cfg, params = setup
     mesh = build_mesh(MeshSpec(sizes={"pipe": 2}, auto_model=False),
