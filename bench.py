@@ -17,12 +17,17 @@ Robustness contract (round-2 hardening):
 
 Measures, for a TinyLlama-1.1B-architecture model (random weights —
 zero-egress image; decode FLOPs/bandwidth are weight-value-independent):
-  1. steady-state decode tok/s through the engine's real hot loop
-     (contiguous KV — the headline `value`),
+  1. steady-state decode tok/s + MFU + HBM GB/s + roofline fraction
+     through the engine's real hot loop (contiguous KV — the headline
+     `value`; prefill compile warmed out of the timing),
   2. p50/p95 TTFT for a request injected while the decode batch is
      saturated (north-star metric #2, BASELINE.md <200 ms),
-  3. the same decode timing with the paged KV layout,
-  4. pallas-vs-jnp cache-attention micro-timing (TPU only).
+  3. the same decode timing with the paged KV layout (page 256),
+  4. a mid-size preset rung (llama-3b-class) — MFU must rise with width,
+  5. a batch-scaling rung (bs=32) — throughput headroom past the
+     comparable bs=8 shape,
+  6. an in-model pallas-vs-jnp attention A/B (whole greedy decode step,
+     slope-timed so remote-tunnel dispatch latency cancels).
 
 ``vs_baseline`` is value / 2000 — the BASELINE.md north-star decode
 tok/s/chip target.
@@ -112,18 +117,22 @@ def _other_python_procs() -> list[str]:
     return out[:8]
 
 
-def build_engine(args, kv_layout: str, preset: str | None = None):
+def build_engine(args, kv_layout: str, preset: str | None = None,
+                 batch: int | None = None):
     from llmapigateway_tpu.config.schemas import LocalEngineConfig
     from llmapigateway_tpu.engine.engine import InferenceEngine
     cfg = LocalEngineConfig(
         preset=preset or args.preset, dtype="bfloat16",
-        max_batch_size=args.batch, max_seq_len=args.seq,
+        max_batch_size=batch or args.batch, max_seq_len=args.seq,
         prefill_chunk=min(512, args.prompt_len),
         decode_burst=args.burst, kv_layout=kv_layout,
         # Paged: page 256 = the dense path's measured-optimal DMA block
         # (tools/profile_decode sweep) — the paged kernel's block IS the
         # page, so page geometry sets its DMA efficiency.
-        kv_page_size=args.page_size)
+        kv_page_size=args.page_size,
+        # The off-thread sampler pre-compile would churn CPU during the
+        # TTFT probes; the bench measures the greedy path only.
+        prewarm_sampler_variants=False)
     t0 = time.monotonic()
     engine = InferenceEngine(cfg)
     init_s = time.monotonic() - t0
@@ -447,6 +456,9 @@ def main() -> None:
                     help="mid-size preset for the MFU-vs-width rung "
                          "('' disables)")
     ap.add_argument("--second-steps", type=int, default=96)
+    ap.add_argument("--scale-batch", type=int, default=32,
+                    help="extra decode rung at this batch size (0 disables)")
+    ap.add_argument("--scale-steps", type=int, default=64)
     args = ap.parse_args()
 
     extra: dict = {}
@@ -515,6 +527,21 @@ def main() -> None:
         except Exception as e:
             errors.append(f"second_preset: {e!r}")
             note(f"FAILED second-preset phase: {e!r}")
+
+    # -- phase 4b: batch-scaling rung (same model, bs=32) --------------------
+    if args.scale_batch and args.scale_batch != args.batch:
+        try:
+            engine, init_s = build_engine(args, "contiguous",
+                                          batch=args.scale_batch)
+            r = fill_and_time_decode(engine, args, steps=args.scale_steps)
+            extra["batch_scale"] = {
+                "batch": args.scale_batch, "tok_s": r["tok_s"],
+                "ms_per_decode_step": r["ms_per_decode_step"],
+                "mfu": r["mfu"], "hbm_gbps": r["hbm_gbps"]}
+            del engine
+        except Exception as e:
+            errors.append(f"batch_scale: {e!r}")
+            note(f"FAILED batch-scale phase: {e!r}")
 
     # -- phase 5: in-model attention A/B -------------------------------------
     try:

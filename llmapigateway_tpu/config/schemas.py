@@ -43,6 +43,10 @@ class LocalEngineConfig(BaseModel):
     kv_num_pages: int = 0           # 0 → derived from max_batch_size*max_seq_len
     prefill_chunk: int = 512
     decode_burst: int = 8           # chained decode steps per host sync
+    # Burst depth while new work is waiting (prefill interleave): deep
+    # enough to amortize dispatch latency, shallow enough that admission
+    # never waits long. 1 = legacy fully-synchronous busy stepping.
+    decode_burst_busy: int = 4
     max_tokens_default: int = 1024
     attention: str = "auto"         # "auto" | "pallas" | "reference"
     # Attention pattern for a seq-sharded mesh: "ring" rotates KV blocks over
@@ -53,6 +57,10 @@ class LocalEngineConfig(BaseModel):
     # Persistent XLA compilation cache: second engine init skips the 30-60 s
     # trace+compile. "" → ~/.cache/llmapigateway_tpu/xla; "off" disables.
     compilation_cache_dir: str = ""
+    # Pre-compile BOTH sampler variants (greedy + general) off-thread on
+    # start() so the first temperature>0 request doesn't stall mid-serving.
+    # Benchmarks disable it (the compile churn competes with latency probes).
+    prewarm_sampler_variants: bool = True
     # Numerics sanitizer (SURVEY.md §5 "race detection / sanitizers"): raise
     # on NaN production inside compiled programs (costs performance; debug).
     debug_nans: bool = False

@@ -148,6 +148,8 @@ class InferenceEngine:
         self.S = min(engine_cfg.max_seq_len, model_cfg.max_seq_len)
         self.prefill_chunk = engine_cfg.prefill_chunk
         self.decode_burst = max(1, engine_cfg.decode_burst)
+        self.decode_burst_busy = max(1, min(engine_cfg.decode_burst_busy,
+                                            self.decode_burst))
         if engine_cfg.kv_layout not in ("contiguous", "paged"):
             raise ValueError(f"unknown kv_layout {engine_cfg.kv_layout!r}")
         self.paged = engine_cfg.kv_layout == "paged"
@@ -330,6 +332,10 @@ class InferenceEngine:
         # burst's token must not clobber the new request's first token).
         self._pending: tuple | None = None
         self._slot_epoch = np.zeros((self.B,), np.int64)
+        # Rolling decode-rate gauge for /v1/api/engine-stats (EMA over
+        # full-size bursts; ms per decode step including scheduler-side
+        # overhead — the number an operator compares against the bench).
+        self._ema_step_ms: float | None = None
 
     def _compile(self) -> None:
         if self.paged:
@@ -431,7 +437,8 @@ class InferenceEngine:
             return next_tokens, new_lengths, cache
 
         self._prefill_fn = prefill_step
-        self._decode_fns = _decode_programs(one_step, self.decode_burst)
+        self._decode_fns = _decode_programs(
+            one_step, (self.decode_burst, self.decode_burst_busy))
 
     def _resolve_attention_impl(self) -> str:
         """Validate cfg.attention and resolve "auto" (pallas on real TPU;
@@ -523,7 +530,8 @@ class InferenceEngine:
                     PagedKVCache(k=cache.k, v=cache.v))
 
         self._prefill_fn = prefill_step
-        self._decode_fns = _decode_programs(one_step, self.decode_burst)
+        self._decode_fns = _decode_programs(
+            one_step, (self.decode_burst, self.decode_burst_busy))
 
     @property
     def _decode_fn(self):
@@ -532,8 +540,9 @@ class InferenceEngine:
 
     @property
     def _decode_scan_fn(self):
-        """Back-compat alias: the general-sampler fused-burst program."""
-        return self._decode_fns[False][1]
+        """Back-compat alias: the general-sampler deep fused-burst
+        program (None when decode_burst == 1)."""
+        return self._decode_fns[False][1].get(self.decode_burst)
 
     def _warm_decode_variants(self) -> None:
         """AOT lower+compile the greedy AND general decode programs from
@@ -559,8 +568,9 @@ class InferenceEngine:
                     vec(jnp.int32), vec(jnp.int32), vec(jnp.bool_),
                     samp_a, aval(self._rng))
             for greedy in (False, True):
-                step, scan = self._decode_fns[greedy]
-                (scan if scan is not None else step).lower(*args).compile()
+                step, scans = self._decode_fns[greedy]
+                for fn in (scans.values() if scans else [step]):
+                    fn.lower(*args).compile()
         except Exception:
             logger.debug("decode program pre-warm failed", exc_info=True)
 
@@ -613,7 +623,8 @@ class InferenceEngine:
             self._enable_debug_nans()
             self._loop_task = asyncio.get_running_loop().create_task(
                 self._run_loop())
-        if self._warm_thread is None and jax.default_backend() == "tpu":
+        if (self._warm_thread is None and self.cfg.prewarm_sampler_variants
+                and jax.default_backend() == "tpu"):
             # Pre-lower+compile BOTH sampler variants into the persistent
             # compilation cache off-thread: without this, the first
             # temperature>0 request after a greedy-only warm-up stalls
@@ -769,7 +780,7 @@ class InferenceEngine:
                     if not r.done and r.slot not in self._prefilling]
         if decoding:
             busy = not self._queue.empty() or bool(self._prefilling)
-            burst = 1 if busy else self.decode_burst
+            burst = self.decode_burst_busy if busy else self.decode_burst
             # Never burst past any slot's cache capacity or token budget —
             # both computed from DISPATCH-TRUE state (self.lengths advances
             # at dispatch): with lag-one pipelining, len(r.generated) lags
@@ -884,8 +895,9 @@ class InferenceEngine:
         greedy = not bool(np.any(
             np.asarray(state["temperature"])[np.asarray(state["active"])]
             > 0))
-        step_fn, scan_fn = self._decode_fns[greedy]
-        if n_steps == self.decode_burst and scan_fn is not None:
+        step_fn, scans = self._decode_fns[greedy]
+        scan_fn = scans.get(n_steps)
+        if scan_fn is not None:
             toks, _, _, self.cache = scan_fn(
                 self.params, self.cache, *table, tokens, lengths, active,
                 samp, key)
@@ -1025,8 +1037,9 @@ class InferenceEngine:
         # (the common case), run the argmax-only program — the general
         # sampler's full-vocab sort costs measurable per-step time.
         greedy = not bool(np.any(self.samp_temperature[self.active] > 0))
-        step_fn, scan_fn = self._decode_fns[greedy]
-        if n_steps == self.decode_burst and scan_fn is not None:
+        step_fn, scans = self._decode_fns[greedy]
+        scan_fn = scans.get(n_steps)
+        if scan_fn is not None:
             # Full-size burst → the single fused scan program, lag-one
             # pipelined: dispatch burst N, then fetch burst N-1 — its
             # device→host copy was queued at its own dispatch
@@ -1034,6 +1047,7 @@ class InferenceEngine:
             # computes and the asarray below is (near-)immediate. Partial
             # bursts (tail of a request's token budget, or prefill work
             # pending) fall through to the synchronous step loop below.
+            t0 = time.monotonic()
             self._rng, key = jax.random.split(self._rng)
             toks, self._d_tokens, self._d_lengths, self.cache = \
                 scan_fn(
@@ -1048,7 +1062,17 @@ class InferenceEngine:
             # Host length mirror advances at DISPATCH time — the burst-
             # capping logic in _step must see the device-true lengths.
             self.lengths[self.active] += n_steps
-            return pre + self._flush_entry(prev)
+            out = pre + self._flush_entry(prev)
+            if prev is not None and prev[1] == n_steps:
+                # Steady state at a constant depth: this call's wall time
+                # covers exactly one same-depth burst. Depth transitions
+                # (busy<->idle) are skipped — dividing the previous deep
+                # burst's wait by the new shallow depth would feed ~4x-off
+                # samples into the gauge.
+                ms = 1000.0 * (time.monotonic() - t0) / n_steps
+                self._ema_step_ms = ms if self._ema_step_ms is None else \
+                    0.8 * self._ema_step_ms + 0.2 * ms
+            return out
 
         # Synchronous path: flush any in-flight burst first so tokens are
         # returned in generation order.
@@ -1161,6 +1185,12 @@ class InferenceEngine:
             out["free_pages"] = self.allocator.free_pages
             out["total_pages"] = self.allocator.num_pages - 1
             out["page_size"] = self.allocator.page_size
+        if self._ema_step_ms is not None:
+            out["decode_ms_per_step"] = round(self._ema_step_ms, 3)
+            active_n = int(self.active.sum())
+            if active_n:
+                out["decode_tok_s"] = round(
+                    1000.0 * active_n / self._ema_step_ms, 1)
         return out
 
 
@@ -1204,36 +1234,45 @@ def _seq_prefill_attention_fn(mesh, kind: str = "ring"):
     return attention_fn
 
 
-def _decode_programs(one_step, n_burst: int):
+def _decode_programs(one_step, burst_lens: tuple[int, ...]):
     """Build the decode programs from one step body: the per-step program,
-    and (when bursting) the fused lax.scan over `n_burst` steps — ONE
+    and a fused lax.scan per distinct burst length in ``burst_lens`` — ONE
     dispatch + ONE host fetch per burst instead of per step; through a
     remote-device tunnel, dispatch latency is the decode bottleneck, not
-    FLOPs. `one_step(params, cache, [table,] tokens, lengths, active, samp,
-    key, greedy=) -> (next_tokens, new_lengths, cache)`.
+    FLOPs. Two lengths are compiled in practice: the deep throughput burst
+    and the shallow "busy" burst used while prefill work is interleaving
+    (so busy-mode decode stays pipelined instead of dropping to
+    synchronous single steps). `one_step(params, cache, [table,] tokens,
+    lengths, active, samp, key, greedy=) -> (next_tokens, new_lengths,
+    cache)`.
 
-    Returns ``{greedy: (step, scan)}`` for greedy in (False, True); the
-    scheduler picks per burst (jit compiles lazily, so an engine that only
-    ever serves one mode compiles one set)."""
+    Returns ``{greedy: (step, {n: scan})}`` for greedy in (False, True);
+    the scheduler picks per burst (jit compiles lazily, so an engine that
+    only ever serves one mode compiles one set)."""
+    lens = sorted({n for n in burst_lens if n > 1})
+
     def build(greedy: bool):
         step = partial(one_step, greedy=greedy)
         decode_step = partial(jax.jit, donate_argnums=(1,))(step)
 
-        @partial(jax.jit, donate_argnums=(1,))
-        def decode_scan(params, cache, *rest):
-            *table, tokens, lengths, active, samp, key = rest
+        def make_scan(n_burst: int):
+            @partial(jax.jit, donate_argnums=(1,))
+            def decode_scan(params, cache, *rest):
+                *table, tokens, lengths, active, samp, key = rest
 
-            def body(carry, _):
-                cache, tokens, lengths, key = carry
-                key, sub = jax.random.split(key)
-                nt, nl, cache = step(params, cache, *table, tokens,
-                                     lengths, active, samp, sub)
-                return (cache, nt, nl, key), nt
-            (cache, tokens, lengths, key), toks = jax.lax.scan(
-                body, (cache, tokens, lengths, key), None, length=n_burst)
-            return toks, tokens, lengths, cache
+                def body(carry, _):
+                    cache, tokens, lengths, key = carry
+                    key, sub = jax.random.split(key)
+                    nt, nl, cache = step(params, cache, *table, tokens,
+                                         lengths, active, samp, sub)
+                    return (cache, nt, nl, key), nt
+                (cache, tokens, lengths, key), toks = jax.lax.scan(
+                    body, (cache, tokens, lengths, key), None,
+                    length=n_burst)
+                return toks, tokens, lengths, cache
+            return decode_scan
 
-        return decode_step, (decode_scan if n_burst > 1 else None)
+        return decode_step, {n: make_scan(n) for n in lens}
 
     return {greedy: build(greedy) for greedy in (False, True)}
 

@@ -329,6 +329,24 @@ def swiglu_mlp(x: jax.Array, wg: jax.Array, wu: jax.Array,
     return (gate * (x @ wu)) @ wd
 
 
+def qkv_proj(h: jax.Array, lp: dict, config: ModelConfig
+             ) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Q/K/V projections with the optional qwen2-family bias, RoPE NOT yet
+    applied. THE one copy of this block — the sequential layer scan and the
+    pipeline-parallel staged block both call it (the bias was once added to
+    only one of the two, silently forking the model). ``"bq" in lp`` is
+    static at trace time. h [B, T, D] → q [B,T,H,Dh], k/v [B,T,KV,Dh]."""
+    c = config
+    B, T = h.shape[0], h.shape[1]
+    dh = c.head_dim
+    qp, kp, vp = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+    if "bq" in lp:
+        qp, kp, vp = qp + lp["bq"], kp + lp["bk"], vp + lp["bv"]
+    return (qp.reshape(B, T, c.n_heads, dh),
+            kp.reshape(B, T, c.n_kv_heads, dh),
+            vp.reshape(B, T, c.n_kv_heads, dh))
+
+
 # ---------------------------------------------------------------------------
 # Forward
 # ---------------------------------------------------------------------------
@@ -370,15 +388,9 @@ def forward(params: Params, config: ModelConfig, tokens: jax.Array,
 
     def layer_step(x, scanned):
         lp, layer_k, layer_v = scanned
-        # Attention block ("bq" in lp is static at trace time — qwen2's
-        # QKV bias, absent for plain llama layouts).
+        # Attention block
         h = rms_norm(x, lp["attn_norm"], c.rms_eps)
-        qp, kp, vp = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
-        if "bq" in lp:
-            qp, kp, vp = qp + lp["bq"], kp + lp["bk"], vp + lp["bv"]
-        q = qp.reshape(B, T, c.n_heads, dh)
-        k = kp.reshape(B, T, c.n_kv_heads, dh)
-        v = vp.reshape(B, T, c.n_kv_heads, dh)
+        q, k, v = qkv_proj(h, lp, c)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         if decode_attend is not None:

@@ -59,17 +59,11 @@ def _block_forward(lp_block: dict, c: ModelConfig, x: jax.Array,
     """Run one stage's layer block: scan over the local layers.
     x [Bm, T, D]; k/v_block [Lp, Bm, KV, S, Dh]."""
     B, T, _ = x.shape
-    dh = c.head_dim
 
     def layer_step(x, scanned):
         lp, layer_k, layer_v = scanned
         h = llama.rms_norm(x, lp["attn_norm"], c.rms_eps)
-        qp, kp, vp = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
-        if "bq" in lp:                    # qwen2-family QKV bias
-            qp, kp, vp = qp + lp["bq"], kp + lp["bk"], vp + lp["bv"]
-        q = qp.reshape(B, T, c.n_heads, dh)
-        k = kp.reshape(B, T, c.n_kv_heads, dh)
-        v = vp.reshape(B, T, c.n_kv_heads, dh)
+        q, k, v = llama.qkv_proj(h, lp, c)
         q = llama.apply_rope(q, cos, sin)
         k = llama.apply_rope(k, cos, sin)
         attn, layer_k, layer_v = llama.dense_cache_attention(

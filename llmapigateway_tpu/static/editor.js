@@ -286,7 +286,9 @@ async function loadFile(which) {
     const text = await resp.text();
     original[which] = text;
     $("editor-" + which).value = text;
-    refresh(which);
+    // immediate: a stale error marker must not linger on fresh content
+    // for the lint debounce interval.
+    refresh(which, { immediate: true });
     setStatus(status, "loaded", "ok");
   } catch (e) {
     setStatus(status, "load failed: " + e, "err");

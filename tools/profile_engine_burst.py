@@ -68,7 +68,7 @@ def main():
 
     # Split: dispatch only vs fetch — use the SAME program _decode_burst
     # picked (greedy: bench slots decode at temperature 0).
-    scan_fn = engine._decode_fns[True][1]
+    scan_fn = engine._decode_fns[True][1][args.burst]
     table = (engine._device_table(),) if engine.paged else ()
     for i in range(3):
         engine._rng, key = jax.random.split(engine._rng)
