@@ -459,6 +459,10 @@ def main() -> None:
     ap.add_argument("--scale-batch", type=int, default=32,
                     help="extra decode rung at this batch size (0 disables)")
     ap.add_argument("--scale-steps", type=int, default=64)
+    ap.add_argument("--max-seconds", type=float, default=900.0,
+                    help="soft deadline: optional phases are skipped once "
+                         "elapsed time passes this, so the one-line JSON "
+                         "always lands inside a driver timeout")
     args = ap.parse_args()
 
     extra: dict = {}
@@ -514,8 +518,16 @@ def main() -> None:
             errors.append(f"paged: {e!r}")
             note(f"FAILED paged phase: {e!r}")
 
+    def over_budget(phase: str) -> bool:
+        if time.monotonic() - T0 <= args.max_seconds:
+            return False
+        note(f"soft deadline {args.max_seconds:.0f}s passed — skipping "
+             f"{phase}")
+        extra.setdefault("skipped_phases", []).append(phase)
+        return True
+
     # -- phase 4: mid-size preset (MFU-vs-width rung) ------------------------
-    if args.second_preset:
+    if args.second_preset and not over_budget("second_preset"):
         try:
             engine, init_s = build_engine(args, "contiguous",
                                           preset=args.second_preset)
@@ -529,7 +541,8 @@ def main() -> None:
             note(f"FAILED second-preset phase: {e!r}")
 
     # -- phase 4b: batch-scaling rung (same model, bs=32) --------------------
-    if args.scale_batch and args.scale_batch != args.batch:
+    if (args.scale_batch and args.scale_batch != args.batch
+            and not over_budget("batch_scale")):
         try:
             engine, init_s = build_engine(args, "contiguous",
                                           batch=args.scale_batch)
@@ -545,7 +558,8 @@ def main() -> None:
 
     # -- phase 5: in-model attention A/B -------------------------------------
     try:
-        extra.update(attention_inmodel_ab(args))
+        if not over_budget("attention_ab"):
+            extra.update(attention_inmodel_ab(args))
     except Exception as e:
         errors.append(f"attention: {e!r}")
         note(f"FAILED attention phase: {e!r}")
