@@ -1,0 +1,37 @@
+"""bench.py is the driver-facing scoring interface: whatever else changes,
+`python bench.py` must emit ONE parseable JSON line with the contract
+fields. Run tiny on CPU (all heavy phases exercised with toy shapes)."""
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_bench_emits_contract_json_line():
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, str(REPO / "bench.py"),
+         "--kv", "both", "--skip-ttft", "--batch", "2", "--steps", "8",
+         "--warmup", "4", "--burst", "4", "--seq", "256",
+         "--prompt-len", "16", "--preset", "tiny-test",
+         "--second-preset", "tiny-test", "--second-steps", "4",
+         "--scale-batch", "4", "--scale-steps", "4"],
+        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
+    assert len(lines) == 1, f"expected ONE json line, got: {r.stdout!r}"
+    data = json.loads(lines[0])
+    for field in ("metric", "value", "unit", "vs_baseline", "extra"):
+        assert field in data, field
+    assert data["value"] > 0
+    assert data["unit"] == "tok/s"
+    extra = data["extra"]
+    # The r3 metric surface the judge reads.
+    for field in ("ms_per_decode_step", "prefill_tok_s", "mfu", "hbm_gbps",
+                  "roofline_fraction", "paged_tok_s", "second_preset",
+                  "batch_scale"):
+        assert field in extra, (field, sorted(extra))
+    assert "phase_errors" not in extra, extra["phase_errors"]
