@@ -533,17 +533,6 @@ class InferenceEngine:
         self._decode_fns = _decode_programs(
             one_step, (self.decode_burst, self.decode_burst_busy))
 
-    @property
-    def _decode_fn(self):
-        """Back-compat alias: the general-sampler per-step program."""
-        return self._decode_fns[False][0]
-
-    @property
-    def _decode_scan_fn(self):
-        """Back-compat alias: the general-sampler deep fused-burst
-        program (None when decode_burst == 1)."""
-        return self._decode_fns[False][1].get(self.decode_burst)
-
     def _warm_decode_variants(self) -> None:
         """AOT lower+compile the greedy AND general decode programs from
         input avals (no device buffers touched), populating the persistent

@@ -55,6 +55,25 @@ def _interpret_default() -> bool:
 # Decode kernel: q [B, KV, G, Dh] vs cache [B, KV, S, Dh], ragged by n_valid
 # ---------------------------------------------------------------------------
 
+def self_column_init(q_ref, kn_ref, vn_ref, m_ref, l_ref, acc_ref) -> None:
+    """Initialize a decode kernel's online-softmax state from the SELF
+    column (the new token attending itself): m = q·k_new, l = 1,
+    acc = v_new. The cache is STALE — the current token's K/V never
+    touched HBM; its contribution lives entirely in registers (the
+    deferred-insert decode protocol, models/llama.py forward()). Shared by
+    the dense and paged decode kernels."""
+    q = q_ref[0, 0].astype(jnp.float32)            # [G, Dh]
+    kn = kn_ref[0, 0].astype(jnp.float32)          # [1, Dh]
+    vn = vn_ref[0, 0].astype(jnp.float32)          # [1, Dh]
+    self_s = jax.lax.dot_general(
+        q, kn, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)        # [G, 1]
+    self_s *= q.shape[-1] ** -0.5
+    m_ref[:] = jnp.broadcast_to(self_s, m_ref.shape)
+    l_ref[:] = jnp.ones_like(l_ref)
+    acc_ref[:] = jnp.broadcast_to(vn, acc_ref.shape)
+
+
 def _decode_kernel(nvalid_ref, q_ref, kn_ref, vn_ref, k_ref, v_ref, o_ref,
                    m_ref, l_ref, acc_ref, *, block_s: int):
     b = pl.program_id(0)
@@ -63,21 +82,7 @@ def _decode_kernel(nvalid_ref, q_ref, kn_ref, vn_ref, k_ref, v_ref, o_ref,
 
     @pl.when(s == 0)
     def _init():
-        # Initialize the online softmax from the SELF column (the new
-        # token attending itself): m = q·k_new, l = 1, acc = v_new. The
-        # cache is STALE — the current token's K/V never touched HBM; its
-        # contribution lives entirely in registers here (deferred-insert
-        # decode protocol, models/llama.py forward()).
-        q = q_ref[0, 0].astype(jnp.float32)            # [G, Dh]
-        kn = kn_ref[0, 0].astype(jnp.float32)          # [1, Dh]
-        vn = vn_ref[0, 0].astype(jnp.float32)          # [1, Dh]
-        self_s = jax.lax.dot_general(
-            q, kn, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)        # [G, 1]
-        self_s *= q.shape[-1] ** -0.5
-        m_ref[:] = jnp.broadcast_to(self_s, m_ref.shape)
-        l_ref[:] = jnp.ones_like(l_ref)
-        acc_ref[:] = jnp.broadcast_to(vn, acc_ref.shape)
+        self_column_init(q_ref, kn_ref, vn_ref, m_ref, l_ref, acc_ref)
 
     n_valid = nvalid_ref[b]
 

@@ -41,6 +41,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..models.config import ModelConfig
+from .flash_attention import self_column_init
 
 NEG_INF = -1e30
 
@@ -150,19 +151,7 @@ def _paged_decode_kernel(pt_ref, nvalid_ref, q_ref, kn_ref, vn_ref,
 
     @pl.when(j == 0)
     def _init():
-        # Self-column init (deferred-insert decode, see
-        # ops/flash_attention.py _decode_kernel): m = q·k_new, l = 1,
-        # acc = v_new. The pool is stale; the current token never hits HBM.
-        q = q_ref[0, 0].astype(jnp.float32)            # [G, Dh]
-        kn = kn_ref[0, 0].astype(jnp.float32)          # [1, Dh]
-        vn = vn_ref[0, 0].astype(jnp.float32)          # [1, Dh]
-        self_s = jax.lax.dot_general(
-            q, kn, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)        # [G, 1]
-        self_s *= q.shape[-1] ** -0.5
-        m_ref[:] = jnp.broadcast_to(self_s, m_ref.shape)
-        l_ref[:] = jnp.ones_like(l_ref)
-        acc_ref[:] = jnp.broadcast_to(vn, acc_ref.shape)
+        self_column_init(q_ref, kn_ref, vn_ref, m_ref, l_ref, acc_ref)
 
     n_valid = nvalid_ref[b]
 

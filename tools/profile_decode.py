@@ -50,7 +50,6 @@ def make_step(c, variant: str, attention_fn=None):
     noattn        — attention replaced by zeros (no insert, no attention)
     noinsert      — attention over the cache WITHOUT the per-step insert
     nomlp         — mlp replaced by identity
-    nolmhead      — skip the [V,D] head matmul
     """
     from llmapigateway_tpu.engine.sampling import sample
     from llmapigateway_tpu.models import llama
@@ -82,11 +81,6 @@ def make_step(c, variant: str, attention_fn=None):
             kwargs["attention_fn"] = attn
         if mlp is not None:
             kwargs["mlp_fn"] = mlp
-        if variant == "nolmhead":
-            # Run everything but the head: rebuild forward body via a
-            # 1-logit head is not possible without editing the model, so
-            # approximate by slicing params' head to 128 rows.
-            pass
         logits, cache = llama.forward(
             params, c, tokens[:, None], lengths, cache, active=active,
             **kwargs)
