@@ -48,6 +48,15 @@ class LocalEngineConfig(BaseModel):
     # never waits long. 1 = legacy fully-synchronous busy stepping.
     decode_burst_busy: int = 4
     max_tokens_default: int = 1024
+    # Prompt-lookup speculative decoding: draft N tokens per step from the
+    # slot's own token history, verify in one T=N+1 forward (exact greedy
+    # output — wrong drafts are rejected by construction). 0 = off.
+    # N+1 must be a power of two (kernel blocking): N ∈ {1, 3, 7}.
+    # Engages only while every active slot is greedy; while any
+    # temperature>0 request is active the whole batch is served through
+    # the normal (unaccelerated) decode path. Requires
+    # kv_layout=contiguous, single-process, no seq/pipe.
+    spec_draft_len: int = 0
     attention: str = "auto"         # "auto" | "pallas" | "reference"
     # Attention pattern for a seq-sharded mesh: "ring" rotates KV blocks over
     # ICI (works for any head count); "ulysses" all-to-alls heads<->sequence

@@ -224,6 +224,24 @@ class InferenceEngine:
                     f"n_layers {model_cfg.n_layers} not divisible by "
                     f"pipe={self.pipe_n} stages")
 
+        # Prompt-lookup speculative decoding (engine/speculative.py).
+        self.spec_k = max(0, engine_cfg.spec_draft_len)
+        if self.spec_k:
+            if self.spec_k not in (1, 3, 7):
+                raise ValueError(
+                    f"spec_draft_len must be one of 1, 3, 7 (verify width "
+                    f"k+1 must be a power of two), got {self.spec_k}")
+            if self.paged:
+                raise ValueError("speculative decoding requires "
+                                 "kv_layout=contiguous (v1)")
+            if self.seq_n > 1 or self.pipe_n > 1:
+                raise ValueError("speculative decoding does not compose "
+                                 "with seq/pipe sharding (v1)")
+            if self._bridge.enabled:
+                raise ValueError("speculative decoding is single-process "
+                                 "only (v1): the multihost command stream "
+                                 "carries fixed step counts")
+
         self.tokenizer = load_tokenizer(
             engine_cfg.tokenizer_path or engine_cfg.model_path or None,
             vocab_size=model_cfg.vocab_size)
@@ -336,6 +354,14 @@ class InferenceEngine:
         # full-size bursts; ms per decode step including scheduler-side
         # overhead — the number an operator compares against the bench).
         self._ema_step_ms: float | None = None
+        # Speculative decoding state: host token-history mirror (device
+        # twin rides the dirty upload) + acceptance counters.
+        if self.spec_k:
+            self.hist = np.zeros((self.B, self.S), np.int32)
+            self._d_hist = None
+            self._d_hist_fresh = False
+            self._spec_steps_done = 0
+            self._spec_tokens_out = 0
 
     def _compile(self) -> None:
         if self.paged:
@@ -439,6 +465,17 @@ class InferenceEngine:
         self._prefill_fn = prefill_step
         self._decode_fns = _decode_programs(
             one_step, (self.decode_burst, self.decode_burst_busy))
+
+        if self.spec_k:
+            from .speculative import make_spec_burst, make_spec_step
+            # Scan depth chosen so a worst-case fully-accepted burst emits
+            # about decode_burst tokens (comparable pacing to normal mode).
+            self._spec_scan_len = max(
+                1, self.decode_burst // (self.spec_k + 1))
+            self._spec_scan = make_spec_burst(
+                model_forward, c, self.spec_k, self._spec_scan_len)
+            self._spec_step = partial(jax.jit, donate_argnums=(1,))(
+                make_spec_step(model_forward, c, self.spec_k))
 
     def _resolve_attention_impl(self) -> str:
         """Validate cfg.attention and resolve "auto" (pallas on real TPU;
@@ -650,7 +687,7 @@ class InferenceEngine:
 
     async def submit(self, req: GenRequest) -> None:
         """Admit a request; raises EngineOverloaded when the queue is full."""
-        max_prompt = self.S - 1
+        max_prompt = self.S - 1 - self.spec_k
         if len(req.prompt_ids) > max_prompt:
             raise EngineOverloaded(
                 f"prompt of {len(req.prompt_ids)} tokens exceeds engine "
@@ -769,18 +806,51 @@ class InferenceEngine:
                     if not r.done and r.slot not in self._prefilling]
         if decoding:
             busy = not self._queue.empty() or bool(self._prefilling)
-            burst = self.decode_burst_busy if busy else self.decode_burst
-            # Never burst past any slot's cache capacity or token budget —
-            # both computed from DISPATCH-TRUE state (self.lengths advances
-            # at dispatch): with lag-one pipelining, len(r.generated) lags
-            # a burst behind and would let a whole discarded burst through.
-            for r in decoding:
-                dispatched = int(self.lengths[r.slot]) - len(r.prompt_ids) + 1
-                burst = min(burst,
-                            self.S - int(self.lengths[r.slot]),
-                            max(1, r.max_tokens - dispatched))
-            burst = max(1, burst)
-            step_tokens = await asyncio.to_thread(self._decode_burst, burst)
+            # Speculation verifies against argmax, so it engages only while
+            # EVERY active slot is greedy (the common serving case);
+            # sampled requests flip the whole batch to the normal burst
+            # path for their lifetime — mixed batches stay correct, just
+            # unaccelerated.
+            spec_now = self.spec_k and not bool(
+                np.any(self.samp_temperature[self.active] > 0))
+            if spec_now:
+                # A slot whose dispatch-true length is within k of the
+                # cache extent can't fit a k+1-wide verify (possible when
+                # lag-one normal bursts ran it ahead of emission): fall
+                # back to the 1-wide normal path until emission retires it.
+                spec_now = all(
+                    self.S - int(self.lengths[r.slot]) >= self.spec_k + 1
+                    for r in decoding)
+            if spec_now:
+                # Speculative steps advance 1..k+1 positions each; cap so a
+                # fully-accepted burst fits every slot's cache reserve and
+                # token budget.
+                kp1 = self.spec_k + 1
+                burst = 1 if busy else self._spec_scan_len
+                for r in decoding:
+                    room = (self.S - int(self.lengths[r.slot])) // kp1
+                    dispatched = (int(self.lengths[r.slot])
+                                  - len(r.prompt_ids) + 1)
+                    left = max(1, r.max_tokens - dispatched)
+                    burst = min(burst, max(1, room), -(-left // kp1))
+                step_tokens = await asyncio.to_thread(
+                    self._spec_burst, max(1, burst))
+            else:
+                burst = self.decode_burst_busy if busy else self.decode_burst
+                # Never burst past any slot's cache capacity or token
+                # budget — both computed from DISPATCH-TRUE state
+                # (self.lengths advances at dispatch): with lag-one
+                # pipelining, len(r.generated) lags a burst behind and
+                # would let a whole discarded burst through.
+                for r in decoding:
+                    dispatched = (int(self.lengths[r.slot])
+                                  - len(r.prompt_ids) + 1)
+                    burst = min(burst,
+                                self.S - int(self.lengths[r.slot]),
+                                max(1, r.max_tokens - dispatched))
+                burst = max(1, burst)
+                step_tokens = await asyncio.to_thread(
+                    self._decode_burst, burst)
             for tokens in step_tokens:          # in generation order
                 for req in decoding:
                     if req.done:
@@ -828,6 +898,12 @@ class InferenceEngine:
         req.t_first_token = time.monotonic()
         self.lengths[slot] = len(ids)
         self.last_token[slot] = first_id
+        if self.spec_k:
+            # Token history for prompt-lookup drafting: prompt at [0, P);
+            # the first generated token is the input at P, written by the
+            # spec step that consumes it (see _spec_burst's walk).
+            self.hist[slot, :len(ids)] = ids
+            self.hist[slot, len(ids):] = 0
         self.active[slot] = True
         self.samp_temperature[slot] = req.temperature
         self.samp_top_p[slot] = req.top_p
@@ -944,6 +1020,76 @@ class InferenceEngine:
         coordinator publishes, until shutdown."""
         self._bridge.follow(self._follow_prefill, self._follow_decode)
 
+    def _spec_burst(self, n_steps: int) -> list[np.ndarray]:
+        """Run `n_steps` speculative draft+verify steps (engine/
+        speculative.py) and sync host mirrors EXACTLY from the fetched
+        emitted-token matrix — speculative advances are data-dependent
+        (1..k+1 positions per step), so this path is synchronous rather
+        than lag-one pipelined. Returns emission-ready [B] token rows with
+        -1 beyond each slot's accepted count (the emission loop's existing
+        negative-token skip handles raggedness)."""
+        if self.fault_plan:
+            self.fault_plan.on_decode()
+        # A mixed-mode engine may have a normal burst in flight (the batch
+        # just turned all-greedy): land it first so mirrors are exact.
+        pre = self._flush_pending()
+        if self._d_dirty or not self._d_hist_fresh:
+            rep = NamedSharding(self.mesh, P())
+            self._d_tokens = jax.device_put(self.last_token, rep)
+            self._d_lengths = jax.device_put(self.lengths, rep)
+            self._d_active = jax.device_put(self.active, rep)
+            self._d_hist = jax.device_put(self.hist, rep)
+            self._d_dirty = False
+            self._d_hist_fresh = True
+
+        if n_steps == self._spec_scan_len:
+            emitted, self.cache, self._d_hist, self._d_tokens, \
+                self._d_lengths = self._spec_scan(
+                    self.params, self.cache, self._d_hist, self._d_tokens,
+                    self._d_lengths, self._d_active)
+            host = np.asarray(emitted)                  # [n, B, k+1]
+        else:
+            outs = []
+            for _ in range(n_steps):
+                self._d_tokens, self._d_lengths, self.cache, self._d_hist, \
+                    em, _ = self._spec_step(
+                        self.params, self.cache, self._d_hist,
+                        self._d_tokens, self._d_lengths, self._d_active)
+                try:
+                    em.copy_to_host_async()
+                except Exception:       # backend without async copies
+                    pass
+                outs.append(em)
+            host = np.stack([np.asarray(e) for e in outs])
+
+        kp1 = self.spec_k + 1
+        rows = [host[i, :, t] for i in range(host.shape[0])
+                for t in range(kp1)]
+        # Exact host-mirror walk (lengths / last_token / history): each
+        # step's valid inputs are [current token] + accepted drafts, i.e.
+        # [cur] + emitted[:count-1]; the step's last emitted token becomes
+        # the next input.
+        for slot in np.nonzero(self.active)[0]:
+            pos = int(self.lengths[slot])
+            cur = int(self.last_token[slot])
+            for i in range(host.shape[0]):
+                toks = host[i, slot]
+                count = int((toks >= 0).sum())
+                if count == 0:
+                    continue
+                if pos < self.S:
+                    self.hist[slot, pos] = cur
+                m = min(count - 1, self.S - (pos + 1))
+                if m > 0:
+                    self.hist[slot, pos + 1:pos + 1 + m] = toks[:m]
+                cur = int(toks[count - 1])
+                pos += count
+            self.lengths[slot] = pos
+            self.last_token[slot] = cur
+        self._spec_steps_done += host.shape[0] * int(self.active.sum())
+        self._spec_tokens_out += int((host >= 0).sum())
+        return pre + rows
+
     def _flush_pending(self) -> list[np.ndarray]:
         """Fetch the in-flight burst's tokens (if any) and sync the host
         ``last_token`` mirror for slots that survived unchanged since its
@@ -954,11 +1100,22 @@ class InferenceEngine:
     def _flush_entry(self, entry) -> list[np.ndarray]:
         if entry is None:
             return []
-        toks_dev, n, active_snap, epoch_snap = entry
+        toks_dev, n, active_snap, epoch_snap, len_snap, last_snap = entry
         host = np.asarray(toks_dev)                      # [n, B]
         live = active_snap & (epoch_snap == self._slot_epoch)
         for slot in np.nonzero(live)[0]:
             self.last_token[slot] = int(host[-1][slot])
+            if self.spec_k:
+                # Keep the prompt-lookup history current through the
+                # NORMAL path too (mixed spec/sampled serving): the burst's
+                # inputs were [last@dispatch] + tokens at positions
+                # [L, L+n] (L = dispatch-time length snapshot).
+                L = int(len_snap[slot])
+                if L < self.S:
+                    self.hist[slot, L] = int(last_snap[slot])
+                m = min(n, self.S - (L + 1))
+                if m > 0:
+                    self.hist[slot, L + 1:L + 1 + m] = host[:m, slot]
         if not live.all():
             # Slots released (or released+re-admitted) since this burst's
             # dispatch: their tokens belong to a dead request — mask with
@@ -1047,10 +1204,13 @@ class InferenceEngine:
             except Exception:           # backend without async copies
                 pass
             prev, self._pending = self._pending, (
-                toks, n_steps, self.active.copy(), self._slot_epoch.copy())
+                toks, n_steps, self.active.copy(), self._slot_epoch.copy(),
+                self.lengths.copy(), self.last_token.copy())
             # Host length mirror advances at DISPATCH time — the burst-
             # capping logic in _step must see the device-true lengths.
             self.lengths[self.active] += n_steps
+            if self.spec_k:
+                self._d_hist_fresh = False
             out = pre + self._flush_entry(prev)
             if prev is not None and prev[1] == n_steps:
                 # Steady state at a constant depth: this call's wall time
@@ -1078,10 +1238,20 @@ class InferenceEngine:
                 pass
             pending.append(self._d_tokens)
         step_tokens = [np.asarray(t) for t in pending]
-        # Mirror device-side length advance on the host.
-        self.lengths[self.active] += n_steps
+        # Mirror device-side length advance on the host (+ history for
+        # mixed-mode speculative engines).
         for slot in np.nonzero(self.active)[0]:
+            if self.spec_k:
+                L = int(self.lengths[slot])
+                if L < self.S:
+                    self.hist[slot, L] = int(self.last_token[slot])
+                m = min(n_steps, self.S - (L + 1))
+                for t in range(m):
+                    self.hist[slot, L + 1 + t] = int(step_tokens[t][slot])
             self.last_token[slot] = int(step_tokens[-1][slot])
+        self.lengths[self.active] += n_steps
+        if self.spec_k:
+            self._d_hist_fresh = False
         return pre + step_tokens
 
     # -- emission / lifecycle (event-loop thread only) ------------------------
@@ -1115,8 +1285,11 @@ class InferenceEngine:
             self._finish(req, "length")
             return
         # Exact per-token cache-capacity check (host `lengths` may already be
-        # a whole burst ahead of the token being emitted).
-        if len(req.prompt_ids) + len(req.generated) + 1 >= self.S:
+        # a whole burst ahead of the token being emitted). Speculative
+        # engines reserve k tail positions so a k+1-wide verify never
+        # writes past the cache extent.
+        if (len(req.prompt_ids) + len(req.generated) + 1
+                >= self.S - self.spec_k):
             self._finish(req, "length")
             return
 
@@ -1180,6 +1353,10 @@ class InferenceEngine:
             if active_n:
                 out["decode_tok_s"] = round(
                     1000.0 * active_n / self._ema_step_ms, 1)
+        if self.spec_k and self._spec_steps_done:
+            out["spec_draft_len"] = self.spec_k
+            out["spec_tokens_per_step"] = round(
+                self._spec_tokens_out / self._spec_steps_done, 2)
         return out
 
 
