@@ -459,6 +459,9 @@ def main() -> None:
     ap.add_argument("--scale-batch", type=int, default=32,
                     help="extra decode rung at this batch size (0 disables)")
     ap.add_argument("--scale-steps", type=int, default=64)
+    ap.add_argument("--spec-draft", type=int, default=3,
+                    help="speculative rung draft length (0 disables)")
+    ap.add_argument("--spec-bursts", type=int, default=12)
     ap.add_argument("--max-seconds", type=float, default=900.0,
                     help="soft deadline: optional phases are skipped once "
                          "elapsed time passes this, so the one-line JSON "
@@ -555,6 +558,57 @@ def main() -> None:
         except Exception as e:
             errors.append(f"batch_scale: {e!r}")
             note(f"FAILED batch-scale phase: {e!r}")
+
+    # -- phase 4c: speculative decoding rung ---------------------------------
+    if args.spec_draft and not over_budget("speculative"):
+        try:
+            import numpy as np
+            from llmapigateway_tpu.config.schemas import LocalEngineConfig
+            from llmapigateway_tpu.engine.engine import InferenceEngine
+            cfg = LocalEngineConfig(
+                preset=args.preset, dtype="bfloat16",
+                max_batch_size=args.batch, max_seq_len=args.seq,
+                prefill_chunk=min(512, args.prompt_len),
+                decode_burst=args.burst, spec_draft_len=args.spec_draft,
+                prewarm_sampler_variants=False)
+            engine = InferenceEngine(cfg)
+            # Repetitive prompts — the regime speculation exists for (the
+            # headline `value` stays the honest non-speculative number).
+            rng = np.random.default_rng(5)
+            base = rng.integers(0, engine.model_cfg.vocab_size, 16)
+            prompt = np.tile(base, args.prompt_len // 16 + 1)[
+                :args.prompt_len].astype(np.int32)
+            for slot in range(engine.B):
+                first, engine.cache = engine._exec_prefill(slot, 0, prompt)
+                engine.lengths[slot] = len(prompt)
+                engine.active[slot] = True
+                engine.last_token[slot] = int(base[0])
+                engine.hist[slot, :len(prompt)] = prompt
+            np.asarray(first)
+            engine._d_dirty = True
+            engine._spec_burst(engine._spec_scan_len)       # compile+warm
+            t0 = time.monotonic()
+            toks = 0
+            for _ in range(args.spec_bursts):
+                rows = engine._spec_burst(engine._spec_scan_len)
+                toks += int(sum((r >= 0).sum() for r in rows))
+            dt = time.monotonic() - t0
+            extra["speculative"] = {
+                "draft_len": args.spec_draft,
+                "tokens_per_step": round(
+                    engine._spec_tokens_out / max(1, engine._spec_steps_done),
+                    2),
+                "tok_s": round(toks / dt, 1),
+                "note": "repetitive-text regime; headline value is "
+                        "non-speculative",
+            }
+            note(f"speculative: {extra['speculative']['tok_s']} tok/s at "
+                 f"{extra['speculative']['tokens_per_step']} accepted "
+                 f"tokens/step (draft {args.spec_draft})")
+            del engine
+        except Exception as e:
+            errors.append(f"speculative: {e!r}")
+            note(f"FAILED speculative phase: {e!r}")
 
     # -- phase 5: in-model attention A/B -------------------------------------
     try:
