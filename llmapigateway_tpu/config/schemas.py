@@ -54,8 +54,8 @@ class LocalEngineConfig(BaseModel):
     # N+1 must be a power of two (kernel blocking): N ∈ {1, 3, 7}.
     # Engages only while every active slot is greedy; while any
     # temperature>0 request is active the whole batch is served through
-    # the normal (unaccelerated) decode path. Requires
-    # kv_layout=contiguous, single-process, no seq/pipe.
+    # the normal (unaccelerated) decode path. Works with both KV layouts;
+    # single-process, no seq/pipe sharding.
     spec_draft_len: int = 0
     attention: str = "auto"         # "auto" | "pallas" | "reference"
     # Attention pattern for a seq-sharded mesh: "ring" rotates KV blocks over

@@ -231,9 +231,6 @@ class InferenceEngine:
                 raise ValueError(
                     f"spec_draft_len must be one of 1, 3, 7 (verify width "
                     f"k+1 must be a power of two), got {self.spec_k}")
-            if self.paged:
-                raise ValueError("speculative decoding requires "
-                                 "kv_layout=contiguous (v1)")
             if self.seq_n > 1 or self.pipe_n > 1:
                 raise ValueError("speculative decoding does not compose "
                                  "with seq/pipe sharding (v1)")
@@ -569,6 +566,27 @@ class InferenceEngine:
         self._prefill_fn = prefill_step
         self._decode_fns = _decode_programs(
             one_step, (self.decode_burst, self.decode_burst_busy))
+
+        if self.spec_k:
+            from .speculative import make_spec_burst, make_spec_step
+
+            def make_fwd(tbl):
+                attn = make_paged_attention_fn(tbl, max_seq=S, impl=impl,
+                                               mesh=mesh)
+                return partial(family_forward, attention_fn=attn)
+
+            self._spec_scan_len = max(
+                1, self.decode_burst // (self.spec_k + 1))
+            self._spec_scan = make_spec_burst(
+                None, c, self.spec_k, self._spec_scan_len,
+                make_forward=make_fwd)
+
+            @partial(jax.jit, donate_argnums=(1,))
+            def spec_step1(params, cache, table, hist, tokens, lengths,
+                           active):
+                return make_spec_step(make_fwd(table), c, self.spec_k)(
+                    params, cache, hist, tokens, lengths, active)
+            self._spec_step = spec_step1
 
     def _warm_decode_variants(self) -> None:
         """AOT lower+compile the greedy AND general decode programs from
@@ -1042,18 +1060,19 @@ class InferenceEngine:
             self._d_dirty = False
             self._d_hist_fresh = True
 
+        table = (self._device_table(),) if self.paged else ()
         if n_steps == self._spec_scan_len:
             emitted, self.cache, self._d_hist, self._d_tokens, \
                 self._d_lengths = self._spec_scan(
-                    self.params, self.cache, self._d_hist, self._d_tokens,
-                    self._d_lengths, self._d_active)
+                    self.params, self.cache, *table, self._d_hist,
+                    self._d_tokens, self._d_lengths, self._d_active)
             host = np.asarray(emitted)                  # [n, B, k+1]
         else:
             outs = []
             for _ in range(n_steps):
                 self._d_tokens, self._d_lengths, self.cache, self._d_hist, \
                     em, _ = self._spec_step(
-                        self.params, self.cache, self._d_hist,
+                        self.params, self.cache, *table, self._d_hist,
                         self._d_tokens, self._d_lengths, self._d_active)
                 try:
                     em.copy_to_host_async()

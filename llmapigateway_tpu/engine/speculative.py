@@ -84,8 +84,12 @@ def make_spec_step(model_forward, config, k: int):
         S = hist.shape[1]
         draft = draft_from_history(hist, tokens, lengths, k)        # [B, k]
         seq = jnp.concatenate([tokens[:, None], draft], axis=1)     # [B,k+1]
-        logits, cache = model_forward(params, c, seq, lengths, cache,
-                                      active=active)
+        logits, out = model_forward(params, c, seq, lengths, cache,
+                                    active=active)
+        # Preserve the caller's cache pytree type through the scan carry
+        # (family forwards return llama.KVCache even when the arrays are a
+        # PagedKVCache's pools).
+        cache = type(cache)(k=out.k, v=out.v)
         g = jnp.argmax(logits, axis=-1).astype(jnp.int32)           # [B,k+1]
         # Accept the longest draft prefix that matches the model's own
         # greedy continuation; the token after the last accepted draft is
@@ -111,18 +115,38 @@ def make_spec_step(model_forward, config, k: int):
     return step
 
 
-def make_spec_burst(model_forward, config, k: int, n_steps: int):
+def make_spec_burst(model_forward, config, k: int, n_steps: int,
+                    make_forward=None):
     """Fused scan over ``n_steps`` speculative steps (ONE dispatch).
 
-    Returns ``burst(params, cache, hist, tokens, lengths, active) ->
-    (emitted [n_steps, B, k+1], cache, hist, tokens, lengths)``; lengths
-    and the emitted counts are data-dependent, so the caller syncs host
-    mirrors from the fetched ``emitted`` (count = tokens >= 0 per row).
+    Returns ``burst(params, cache, [table,] hist, tokens, lengths, active)
+    -> (emitted [n_steps, B, k+1], cache, hist, tokens, lengths)``;
+    lengths and the emitted counts are data-dependent, so the caller syncs
+    host mirrors from the fetched ``emitted`` (count = tokens >= 0 per
+    row). ``make_forward(table) -> model_forward`` supports the paged
+    layout, whose attention closes over the traced page table (the table
+    becomes an extra positional arg and ``model_forward`` is ignored).
     """
-    step = make_spec_step(model_forward, config, k)
+    if make_forward is None:
+        step = make_spec_step(model_forward, config, k)
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def burst(params, cache, hist, tokens, lengths, active):
+            def body(carry, _):
+                cache, hist, tokens, lengths = carry
+                nt, nl, cache, hist, emitted, _ = step(
+                    params, cache, hist, tokens, lengths, active)
+                return (cache, hist, nt, nl), emitted
+            (cache, hist, tokens, lengths), emitted = jax.lax.scan(
+                body, (cache, hist, tokens, lengths), None, length=n_steps)
+            return emitted, cache, hist, tokens, lengths
+
+        return burst
 
     @partial(jax.jit, donate_argnums=(1,))
-    def burst(params, cache, hist, tokens, lengths, active):
+    def paged_burst(params, cache, table, hist, tokens, lengths, active):
+        step = make_spec_step(make_forward(table), config, k)
+
         def body(carry, _):
             cache, hist, tokens, lengths = carry
             nt, nl, cache, hist, emitted, _ = step(
@@ -132,4 +156,4 @@ def make_spec_burst(model_forward, config, k: int, n_steps: int):
             body, (cache, hist, tokens, lengths), None, length=n_steps)
         return emitted, cache, hist, tokens, lengths
 
-    return burst
+    return paged_burst

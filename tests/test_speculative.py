@@ -153,10 +153,51 @@ async def test_spec_engine_serves_sampled_via_normal_path():
 def test_spec_config_guardrails():
     with pytest.raises(ValueError, match="1, 3, 7"):
         _engine(spec=4)
-    with pytest.raises(ValueError, match="contiguous"):
-        _engine(spec=3, kv_layout="paged")
     with pytest.raises(ValueError, match="seq/pipe"):
         InferenceEngine(LocalEngineConfig(
             preset="tiny-test", max_batch_size=2, max_seq_len=128,
             prefill_chunk=32, dtype="float32", spec_draft_len=3,
             mesh={"seq": 4}), devices=jax.devices("cpu")[:4])
+
+
+async def test_spec_engine_recovers_from_injected_fault():
+    """A decode fault during speculative serving must error the in-flight
+    request and leave the engine serviceable (state re-init covers the
+    spec mirrors too)."""
+    from llmapigateway_tpu.engine.engine import FaultPlan
+    eng = _engine(spec=3)
+    try:
+        eng.fault_plan = FaultPlan(fail_decode_after=1)
+        req = GenRequest(prompt_ids=[3, 1, 4, 1, 5], max_tokens=12,
+                         temperature=0.0)
+        await eng.submit(req)
+        deltas = []
+        async for d in eng.stream(req):
+            deltas.append(d)
+        assert any(d.error for d in deltas)
+        eng.fault_plan = None
+        ok = await _gen(eng, [3, 1, 4, 1, 5], max_tokens=6)
+        assert ok.finish_reason is not None and len(ok.generated) >= 1
+    finally:
+        await eng.stop()
+
+
+async def test_spec_greedy_parity_paged():
+    """Speculation over the PAGED pool (verify writes beyond a slot's page
+    reservation land on the trash page; the page table threads into the
+    spec program as a traced arg) — tokens must match the plain paged
+    engine's."""
+    rng = np.random.default_rng(4)
+    prompt = list(np.tile(rng.integers(2, 500, 6), 8))
+    ref_eng = _engine(spec=0, kv_layout="paged")
+    try:
+        ref = await _gen(ref_eng, prompt, max_tokens=20)
+    finally:
+        await ref_eng.stop()
+    eng = _engine(spec=3, kv_layout="paged")
+    try:
+        got = await _gen(eng, prompt, max_tokens=20)
+        assert got.generated == ref.generated
+        assert eng.stats()["spec_tokens_per_step"] >= 1.0
+    finally:
+        await eng.stop()
