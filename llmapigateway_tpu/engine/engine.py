@@ -1,17 +1,33 @@
-"""The serving engine: slot-based continuous batching over two compiled XLA
-programs (chunked prefill + batched decode step).
+"""The serving engine: slot-based continuous batching over compiled XLA
+programs (chunked prefill + fused decode bursts + optional speculation).
 
 Design (SURVEY.md §2b "Serving scheduler", §7 steps 5-6):
 
-* **Fixed shapes everywhere.** The decode program is compiled once for the
+* **Fixed shapes everywhere.** Decode programs are compiled once for the
   full slot batch ``[B]``; inactive slots ride along masked (`active`), so
   admission/retirement never recompiles. Prefill is compiled per power-of-2
   chunk bucket, padded — pad tokens land beyond the true length and are
   masked off by the length-based causal mask, then overwritten by the next
-  chunk.
-* **Continuous batching.** New requests are admitted into free slots between
-  decode steps; prefill runs chunk-at-a-time so a long prompt never blocks
-  decode for more than one chunk (chunked-prefill interleave).
+  chunk. The first token is sampled INSIDE the prefill program (one host
+  fetch completes the TTFT path).
+* **Fused, lag-one-pipelined decode bursts.** A burst of decode steps is
+  ONE ``lax.scan`` program (one dispatch, one fetch); burst N+1 dispatches
+  before burst N's tokens are fetched, hiding the device→host round trip
+  under compute. Two burst depths compile: the deep throughput burst and a
+  shallow "busy" burst used while prefill work interleaves. Emission lags
+  one burst; slot release/re-admission races are epoch-guarded
+  (``_flush_entry``).
+* **Deferred-insert decode.** Decode attention reads the STALE cache plus
+  a self-column, and every layer's new K/V is inserted once per step
+  outside the layer scan (models/llama.py ``insert_kv_stacked``) — the
+  per-layer functional insert lowers to serialized TPU scatters.
+* **Greedy fast path + speculation.** When every active slot decodes at
+  temperature 0, an argmax-only program runs (no full-vocab sort), and
+  with ``spec_draft_len`` set, prompt-lookup speculative bursts verify k
+  drafted tokens per weight-streaming pass (engine/speculative.py).
+* **Continuous batching.** New requests are admitted into free slots
+  between bursts; prefill runs chunk-at-a-time so a long prompt never
+  blocks decode for more than one chunk (chunked-prefill interleave).
 * **The engine is an async service.** Compiled-program calls are offloaded
   to a worker thread (`asyncio.to_thread`) so the gateway's event loop keeps
   serving; results stream back through per-sequence asyncio queues.
