@@ -26,7 +26,10 @@ zero-egress image; decode FLOPs/bandwidth are weight-value-independent):
   4. a mid-size preset rung (llama-3b-class) — MFU must rise with width,
   5. a batch-scaling rung (bs=32) — throughput headroom past the
      comparable bs=8 shape,
-  6. an in-model pallas-vs-jnp attention A/B (whole greedy decode step,
+  6. an int8 weight-quantization rung (same shape as the headline;
+     decode is weight-bandwidth-bound so int8 should land near 2×),
+  7. a speculative-decoding rung (repetitive-text regime),
+  8. an in-model pallas-vs-jnp attention A/B (whole greedy decode step,
      slope-timed so remote-tunnel dispatch latency cancels).
 
 ``vs_baseline`` is value / 2000 — the BASELINE.md north-star decode
@@ -118,13 +121,13 @@ def _other_python_procs() -> list[str]:
 
 
 def build_engine(args, kv_layout: str, preset: str | None = None,
-                 batch: int | None = None):
+                 batch: int | None = None, quant: str = ""):
     from llmapigateway_tpu.config.schemas import LocalEngineConfig
     from llmapigateway_tpu.engine.engine import InferenceEngine
     cfg = LocalEngineConfig(
         preset=preset or args.preset, dtype="bfloat16",
         max_batch_size=batch or args.batch, max_seq_len=args.seq,
-        prefill_chunk=min(512, args.prompt_len),
+        prefill_chunk=min(512, args.prompt_len), quant=quant,
         decode_burst=args.burst, kv_layout=kv_layout,
         # Paged: page 256 = the dense path's measured-optimal DMA block
         # (tools/profile_decode sweep) — the paged kernel's block IS the
@@ -459,6 +462,8 @@ def main() -> None:
     ap.add_argument("--scale-batch", type=int, default=32,
                     help="extra decode rung at this batch size (0 disables)")
     ap.add_argument("--scale-steps", type=int, default=64)
+    ap.add_argument("--quant-rung", type=int, default=1,
+                    help="int8 weight-quant decode rung (0 disables)")
     ap.add_argument("--spec-draft", type=int, default=3,
                     help="speculative rung draft length (0 disables)")
     ap.add_argument("--spec-bursts", type=int, default=12)
@@ -484,6 +489,7 @@ def main() -> None:
 
     # -- phase 1+2: contiguous engine — headline decode + TTFT ---------------
     value = 0.0
+    contig_bf16_tok_s = 0.0
     errors = []
     engine = None
     if args.kv in ("contiguous", "both"):
@@ -491,6 +497,7 @@ def main() -> None:
             engine, extra["engine_init_s"] = build_engine(args, "contiguous")
             r = fill_and_time_decode(engine, args)
             value = r.pop("tok_s")
+            contig_bf16_tok_s = value      # quant rung's like-for-like baseline
             extra.update(r)
         except Exception as e:
             errors.append(f"contiguous: {e!r}")
@@ -558,6 +565,34 @@ def main() -> None:
         except Exception as e:
             errors.append(f"batch_scale: {e!r}")
             note(f"FAILED batch-scale phase: {e!r}")
+
+    # -- phase 4d: int8 weight-quantization rung -----------------------------
+    # Same shape as the headline; decode is weight-bandwidth-bound, so int8
+    # weights should land near 2× the bf16 tok/s (models/quant.py). Reported
+    # alongside (not as) the headline `value` so r2→r3 numbers stay
+    # comparable; MFU/GB/s here use the int8 byte footprint.
+    if args.quant_rung and not over_budget("quant_int8"):
+        try:
+            engine, init_s = build_engine(args, "contiguous", quant="int8")
+            r = fill_and_time_decode(engine, args)
+            extra["quant_int8"] = {
+                "tok_s": r["tok_s"],
+                "ms_per_decode_step": r["ms_per_decode_step"],
+                "mfu": r["mfu"], "hbm_gbps": r["hbm_gbps"],
+                "roofline_fraction": r["roofline_fraction"],
+                "init_s": init_s,
+                # Ratio only against the same-layout (contiguous) bf16
+                # number — under --kv paged there is no like-for-like base.
+                "speedup_vs_bf16": (round(r["tok_s"] / contig_bf16_tok_s, 2)
+                                    if contig_bf16_tok_s else None),
+            }
+            sp = extra["quant_int8"]["speedup_vs_bf16"]
+            note(f"quant int8: {r['tok_s']} tok/s"
+                 + (f" ({sp}x bf16)" if sp else ""))
+            del engine
+        except Exception as e:
+            errors.append(f"quant: {e!r}")
+            note(f"FAILED quant phase: {e!r}")
 
     # -- phase 4c: speculative decoding rung ---------------------------------
     if args.spec_draft and not over_budget("speculative"):

@@ -57,6 +57,12 @@ class LocalEngineConfig(BaseModel):
     # the normal (unaccelerated) decode path. Works with both KV layouts;
     # single-process, no seq/pipe sharding.
     spec_draft_len: int = 0
+    # Weight quantization: "int8" stores the seven big matmul weights per
+    # layer + lm_head as symmetric per-channel int8 (activations quantize
+    # dynamically inside the step; models/quant.py). Halves the weight
+    # bytes each decode step streams from HBM — the decode roofline —
+    # at a small accuracy cost (standard W8A8). Llama-family only (v1).
+    quant: str = ""                 # "" | "int8"
     attention: str = "auto"         # "auto" | "pallas" | "reference"
     # Attention pattern for a seq-sharded mesh: "ring" rotates KV blocks over
     # ICI (works for any head count); "ulysses" all-to-alls heads<->sequence

@@ -104,7 +104,9 @@ def _map_name(hf_name: str) -> tuple[str, int | None, int | None, bool] | None:
 
 def load_checkpoint(model_dir: str | Path, config: ModelConfig,
                     dtype: jnp.dtype = jnp.bfloat16,
-                    put: Callable[[str, np.ndarray], jax.Array] | None = None
+                    put: Callable[[str, np.ndarray], jax.Array] | None = None,
+                    preprocess: Callable[[str, np.ndarray],
+                                         np.ndarray | dict] | None = None
                     ) -> dict[str, Any]:
     """Load an HF checkpoint into the stacked-layer params layout.
 
@@ -112,10 +114,20 @@ def load_checkpoint(model_dir: str | Path, config: ModelConfig,
     engine passes a sharded ``device_put``; default is plain host transfer.
     Stacking happens per-parameter: each layer's tensor is placed as soon as
     all layers for that name are read, bounding host memory.
+
+    ``preprocess(param_path, tensor)`` runs on each tensor at the
+    checkpoint's SOURCE precision, before the target-dtype cast and before
+    layer stacking — the int8-quantization hook (quant levels computed from
+    fp16/fp32 source values, not from a bf16-rounded copy, and the host
+    stacks int8 instead of bf16). It may return a ``{"q": ..., "s": ...}``
+    dict; each sub-leaf is then stacked and placed under ``path.key``.
+    Default: cast to ``dtype``.
     """
     model_dir = Path(model_dir)
     shards = _discover_shards(model_dir)
     put = put or (lambda path, arr: jnp.asarray(arr))
+    preprocess = preprocess or (
+        lambda path, arr: arr.astype(_np_dtype(dtype)))
 
     # Pass 1: index — which shard holds each mapped tensor (metadata only).
     index: dict[str, tuple[Path, str, bool, int | None, int | None]] = {}
@@ -136,34 +148,47 @@ def load_checkpoint(model_dir: str | Path, config: ModelConfig,
     # largest single stacked parameter, not the whole checkpoint.
     open_shards: dict[Path, Any] = {}
 
-    def read(name: str) -> np.ndarray:
+    def read(name: str, path: str) -> np.ndarray | dict:
+        """One tensor at source precision → preprocessed (cast/quantized)."""
         shard, _, transpose, _, _ = index[name]
         if shard not in open_shards:
             open_shards[shard] = safe_open(str(shard), framework="numpy")
         arr = np.asarray(open_shards[shard].get_tensor(name))
         if transpose:
             arr = arr.T
-        return arr.astype(_np_dtype(dtype))
+        return preprocess(path, arr)
+
+    def place(path: str, value: np.ndarray | dict):
+        if isinstance(value, dict):
+            return {k: put(f"{path}.{k}", v) for k, v in value.items()}
+        return put(path, value)
+
+    def stack(values: list) -> np.ndarray | dict:
+        if isinstance(values[0], dict):
+            return {k: np.stack([v[k] for v in values]) for k in values[0]}
+        return np.stack(values)
 
     params: dict[str, Any] = {"layers": {}}
     try:
         for key, names in grouped.items():
             entries = [(index[n][3], index[n][4], n) for n in names]
             if entries[0][0] is None:                       # layerless tensor
-                params[key] = put(key, read(names[0]))
+                params[key] = place(key, read(names[0], key))
                 continue
+            path = f"layers.{key}"
             has_experts = any(e is not None for (_, e, _) in entries)
             by_pos = {(l, e): n for l, e, n in entries}
             n_layers = max(l for l, _, _ in entries) + 1
             if has_experts:
                 n_experts = max(e for _, e, _ in entries) + 1
-                stacked = np.stack([
-                    np.stack([read(by_pos[(l, e)]) for e in range(n_experts)])
+                stacked = stack([
+                    stack([read(by_pos[(l, e)], path)
+                           for e in range(n_experts)])
                     for l in range(n_layers)])
             else:
-                stacked = np.stack([read(by_pos[(l, None)])
-                                    for l in range(n_layers)])
-            params["layers"][key] = put(f"layers.{key}", stacked)
+                stacked = stack([read(by_pos[(l, None)], path)
+                                 for l in range(n_layers)])
+            params["layers"][key] = place(path, stacked)
             del stacked
     finally:
         open_shards.clear()
@@ -184,6 +209,12 @@ def _np_dtype(dtype: jnp.dtype):
     return np.dtype(dtype)
 
 
+def _shape(p: Any) -> tuple[int, ...]:
+    """Leaf shape; an int8-quantized leaf is a {"q","s"} dict whose logical
+    shape is the int8 tensor's (models/quant.py)."""
+    return tuple(p["q"].shape) if isinstance(p, dict) else tuple(p.shape)
+
+
 def _validate_shapes(params: dict[str, Any], config: ModelConfig) -> None:
     c = config
     checks = {
@@ -191,7 +222,7 @@ def _validate_shapes(params: dict[str, Any], config: ModelConfig) -> None:
         "final_norm": (c.d_model,),
     }
     for key, want in checks.items():
-        got = tuple(params[key].shape)
+        got = _shape(params[key])
         if got != want:
             raise ValueError(f"checkpoint/config mismatch: {key} is {got}, "
                              f"config implies {want}")
@@ -207,6 +238,6 @@ def _validate_shapes(params: dict[str, Any], config: ModelConfig) -> None:
         raise ValueError(f"checkpoint is missing layer params {sorted(missing)}; "
                          f"loaded keys: {sorted(lk)}")
     want = (c.n_layers, c.d_model, c.n_heads * c.head_dim)
-    if tuple(lk["wq"].shape) != want:
+    if _shape(lk["wq"]) != want:
         raise ValueError(f"checkpoint/config mismatch: layers.wq is "
-                         f"{tuple(lk['wq'].shape)}, config implies {want}")
+                         f"{_shape(lk['wq'])}, config implies {want}")

@@ -240,6 +240,18 @@ class InferenceEngine:
                     f"n_layers {model_cfg.n_layers} not divisible by "
                     f"pipe={self.pipe_n} stages")
 
+        # Int8 weight quantization (models/quant.py): validated here so a
+        # bad config fails at engine build (→ provider error → fallback),
+        # not mid-load.
+        from ..models.quant import QUANT_MODES
+        self.quant = engine_cfg.quant
+        if self.quant not in QUANT_MODES:
+            raise ValueError(f"unknown quant {self.quant!r}; "
+                             f"expected one of {QUANT_MODES}")
+        if self.quant and model_cfg.is_moe:
+            raise ValueError("quant='int8' supports the llama family only "
+                             "(MoE expert matmuls are not quantized in v1)")
+
         # Prompt-lookup speculative decoding (engine/speculative.py).
         self.spec_k = max(0, engine_cfg.spec_draft_len)
         if self.spec_k:
@@ -285,17 +297,34 @@ class InferenceEngine:
         t0 = time.monotonic()
         from ..parallel.multihost import put_global
         if self.cfg.model_path:
-            from .checkpoint import load_checkpoint
+            from .checkpoint import _np_dtype, load_checkpoint
             from ..parallel.sharding import spec_for_param
+            from ..models.quant import (QUANT_TOP_KEYS, _np_quantize,
+                                        quantizes)
 
             def put(path: str, arr: np.ndarray) -> jax.Array:
+                # ".q"/".s" quantized sub-leaves get their own rules.
                 return put_global(
                     arr, spec_for_param(path, tuple(arr.shape), self.mesh))
+
+            def preprocess(path: str, arr: np.ndarray):
+                # quant="int8": quantize each tensor at the checkpoint's
+                # SOURCE precision (not a bf16-rounded copy), per layer,
+                # before stacking — the host stacks and transfers the int8
+                # copy, halving both footprints.
+                if self.quant == "int8" and quantizes(path):
+                    return _np_quantize(
+                        arr, 1 if path in QUANT_TOP_KEYS else 0)
+                return arr.astype(_np_dtype(self.dtype))
             self.params = load_checkpoint(self.cfg.model_path, c,
-                                          dtype=self.dtype, put=put)
+                                          dtype=self.dtype, put=put,
+                                          preprocess=preprocess)
         else:
             key = jax.random.PRNGKey(0)
             host_params = init_fn(c)(c, key, dtype=self.dtype)
+            if self.quant == "int8":
+                from ..models.quant import quantize_tree
+                host_params = quantize_tree(host_params, c)
             shardings = param_shardings(host_params, self.mesh)
             self.params = jax.tree.map(put_global, host_params, shardings)
         n_params = sum(int(np.prod(p.shape))

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 
 from .config import ModelConfig
+from .quant import head_matmul, mm
 
 Params = dict[str, Any]
 
@@ -325,8 +326,10 @@ dense_cache_attention.insert_all = insert_kv_stacked
 
 def swiglu_mlp(x: jax.Array, wg: jax.Array, wu: jax.Array,
                wd: jax.Array) -> jax.Array:
-    gate = jax.nn.silu(x @ wg)
-    return (gate * (x @ wu)) @ wd
+    """Each weight is a plain array or an int8 ``{"q","s"}`` dict
+    (models/quant.py) — ``mm`` dispatches."""
+    gate = jax.nn.silu(mm(x, wg))
+    return mm(gate * mm(x, wu), wd)
 
 
 def qkv_proj(h: jax.Array, lp: dict, config: ModelConfig
@@ -339,7 +342,7 @@ def qkv_proj(h: jax.Array, lp: dict, config: ModelConfig
     c = config
     B, T = h.shape[0], h.shape[1]
     dh = c.head_dim
-    qp, kp, vp = h @ lp["wq"], h @ lp["wk"], h @ lp["wv"]
+    qp, kp, vp = mm(h, lp["wq"]), mm(h, lp["wk"]), mm(h, lp["wv"])
     if "bq" in lp:
         qp, kp, vp = qp + lp["bq"], kp + lp["bk"], vp + lp["bv"]
     return (qp.reshape(B, T, c.n_heads, dh),
@@ -400,7 +403,7 @@ def forward(params: Params, config: ModelConfig, tokens: jax.Array,
             attn, layer_k, layer_v = attention_fn(
                 q, k, v, layer_k, layer_v, lengths, active)
             ys = (layer_k, layer_v)
-        x = x + attn @ lp["wo"]
+        x = x + mm(attn, lp["wo"])
         # MLP block
         h = rms_norm(x, lp["mlp_norm"], c.rms_eps)
         if custom_mlp is not None:
@@ -419,8 +422,7 @@ def forward(params: Params, config: ModelConfig, tokens: jax.Array,
 
     x = rms_norm(x, params["final_norm"], c.rms_eps)
     head = params["embed"] if c.tie_embeddings else params["lm_head"]
-    # bf16 reads of the [V, D] head with fp32 MXU accumulation — an explicit
-    # astype would materialize a full fp32 copy of the vocab matrix per step.
-    logits = jnp.einsum("btd,vd->btv", x, head,
-                        preferred_element_type=jnp.float32)
+    # bf16 (or int8) reads of the [V, D] head with MXU accumulation — an
+    # explicit astype would materialize a fp32 copy of the vocab matrix.
+    logits = head_matmul(x, head)
     return logits, KVCache(k=new_k, v=new_v)

@@ -1,0 +1,151 @@
+"""Int8 weight quantization for the serving engine (W8A8 dynamic).
+
+No reference counterpart — the reference proxies HTTP and never touches
+weights (SURVEY.md §2: no model execution anywhere). This is a TPU-native
+performance feature: steady-state decode is HBM-bandwidth-bound (every
+weight byte is read once per token), so storing matmul weights as int8
+halves the traffic that sets the decode roofline, and the int8×int8
+``dot_general`` runs on the MXU's native int8 path (v5e: 394 int8 TOPS vs
+197 bf16 TFLOPS).
+
+Scheme (standard dynamic W8A8, no calibration data needed):
+
+* **Weights**: symmetric per-output-channel int8. For a projection
+  ``w [D, F]`` (contract over D) the scale is ``s [F] = max|w[:, f]|/127``
+  stored fp32; a quantized weight is the sub-dict ``{"q": int8, "s": fp32}``
+  in the params tree (a plain pytree — ``lax.scan`` over stacked layers,
+  GSPMD sharding, and multihost broadcast all see ordinary leaves).
+* **Activations**: symmetric per-row dynamic int8, computed inside the
+  compiled step (``max|x|`` over the contraction dim — XLA fuses this with
+  the surrounding elementwise work). Row scales commute with the matmul, so
+  the result is exact int32 arithmetic rescaled once:
+  ``y = (xq @ wq) * xs * s``. Under tensor parallelism the int32 partial
+  sums are summed exactly (integer psum) before the fp32 rescale.
+* RMSNorm, rotary, embedding gather, KV cache, and logits stay in their
+  usual dtypes — only the seven big matmuls per layer (wq/wk/wv/wo and
+  wg/wu/wd) and the lm_head are quantized; those carry ~99% of the weight
+  bytes of a llama-family model.
+
+``mm``/``head_matmul`` are the single dispatch points: they accept either a
+plain array or a quantized dict, so model code (models/llama.py) is layout-
+agnostic and a checkpoint loaded with ``quant: "int8"`` streams through the
+same forward as a bf16 one.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .config import ModelConfig
+
+# Layer-stacked weights that quantize (contract dim 1 of [L, D_in, D_out]).
+QUANT_LAYER_KEYS = frozenset({"wq", "wk", "wv", "wo", "wg", "wu", "wd"})
+# Top-level weights that quantize ([V, D], contract over D → scale per V).
+QUANT_TOP_KEYS = frozenset({"lm_head"})
+
+QUANT_MODES = ("", "int8")
+
+
+def is_quantized(w: Any) -> bool:
+    return isinstance(w, dict) and "q" in w and "s" in w
+
+
+def _np_quantize(arr: np.ndarray, contract_axis: int) -> dict[str, np.ndarray]:
+    """Host-side symmetric per-channel quantization (checkpoint load path —
+    the int8 copy, not the bf16 original, is what crosses PCIe/DCN)."""
+    f = np.asarray(arr, np.float32)
+    amax = np.max(np.abs(f), axis=contract_axis, keepdims=True)
+    scale = np.maximum(amax, 1e-30) / 127.0
+    q = np.clip(np.rint(f / scale), -127, 127).astype(np.int8)
+    return {"q": q, "s": np.squeeze(scale, axis=contract_axis)}
+
+
+def quantize_array(w: jax.Array, contract_axis: int) -> dict[str, jax.Array]:
+    """Device-side twin of :func:`_np_quantize` (random-init path)."""
+    f = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(f), axis=contract_axis, keepdims=True)
+    scale = jnp.maximum(amax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(f / scale), -127, 127).astype(jnp.int8)
+    return {"q": q, "s": jnp.squeeze(scale, axis=contract_axis)}
+
+
+def quantizes(path: str) -> bool:
+    """Whether a param path participates in int8 quantization (v1 surface:
+    llama-family stacked layer matmuls + lm_head; MoE stays bf16)."""
+    if path in QUANT_TOP_KEYS:
+        return True
+    return (path.startswith("layers.")
+            and path.split(".", 1)[1] in QUANT_LAYER_KEYS)
+
+
+def contract_axis_for(path: str, ndim: int) -> int | None:
+    """Which axis a quantized *stacked* weight contracts over, or None if
+    the param doesn't quantize. Paths follow parallel/sharding.py's dot-key
+    scheme. MoE expert weights (ndim 4) return None — not quantized in v1
+    (the engine rejects quant for MoE models outright)."""
+    if not quantizes(path) or ndim == 4:
+        return None
+    return 1        # lm_head [V, D] → per-V; layers [L, D_in, D_out] → dim 1
+
+
+def quantize_tree(params: dict, config: ModelConfig) -> dict:
+    """Replace every quantizable leaf of a params tree with its
+    ``{"q", "s"}`` dict (random-init path; checkpoint load quantizes
+    per-parameter on the host instead — engine/checkpoint.py put hook)."""
+    if config.is_moe:
+        raise ValueError("quant='int8' supports the llama family only "
+                         "(MoE expert matmuls are not quantized in v1)")
+    out: dict = {}
+    for key, val in params.items():
+        if key == "layers":
+            out[key] = {
+                k: (quantize_array(v, contract_axis_for(f"layers.{k}", v.ndim))
+                    if contract_axis_for(f"layers.{k}", v.ndim) is not None
+                    else v)
+                for k, v in val.items()
+            }
+        elif contract_axis_for(key, getattr(val, "ndim", 0)) is not None:
+            out[key] = quantize_array(val, contract_axis_for(key, val.ndim))
+        else:
+            out[key] = val
+    return out
+
+
+def _dynamic_int8(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Per-row symmetric int8 quantization of activations over the last dim.
+    Returns (xq int8, xs fp32 with a keepdims-1 trailing axis)."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    xs = jnp.maximum(amax, 1e-30) / 127.0
+    xq = jnp.clip(jnp.round(xf / xs), -127, 127).astype(jnp.int8)
+    return xq, xs
+
+
+def mm(x: jax.Array, w: Any) -> jax.Array:
+    """``x [..., D] @ w [D, F]`` where ``w`` is a plain array or a quantized
+    ``{"q", "s"}`` dict. Result in ``x.dtype`` either way."""
+    if not is_quantized(w):
+        return x @ w
+    xq, xs = _dynamic_int8(x)
+    acc = jax.lax.dot_general(
+        xq, w["q"], (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    y = acc.astype(jnp.float32) * xs * w["s"]
+    return y.astype(x.dtype)
+
+
+def head_matmul(x: jax.Array, head: Any) -> jax.Array:
+    """Logits: ``x [B, T, D] · head [V, D] → [B, T, V]`` fp32. Plain head
+    keeps the bf16-read / fp32-accumulate einsum; a quantized head contracts
+    int8 against dim 1 directly (no transposed copy materializes)."""
+    if not is_quantized(head):
+        return jnp.einsum("btd,vd->btv", x, head,
+                          preferred_element_type=jnp.float32)
+    xq, xs = _dynamic_int8(x)
+    acc = jax.lax.dot_general(
+        xq, head["q"], (((x.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)
+    return acc.astype(jnp.float32) * xs * head["s"]

@@ -68,7 +68,7 @@ def _block_forward(lp_block: dict, c: ModelConfig, x: jax.Array,
         k = llama.apply_rope(k, cos, sin)
         attn, layer_k, layer_v = llama.dense_cache_attention(
             q, k, v, layer_k, layer_v, lengths, active)
-        x = x + attn @ lp["wo"]
+        x = x + llama.mm(attn, lp["wo"])
         h = llama.rms_norm(x, lp["mlp_norm"], c.rms_eps)
         x = x + llama.swiglu_mlp(h, lp["wg"], lp["wu"], lp["wd"])
         return x, (layer_k, layer_v)
@@ -160,8 +160,7 @@ def _build_run(c: ModelConfig, mesh: Mesh, n_stages: int, M: int, Bm: int,
         x = outs.reshape(B, T, -1)
         x = llama.rms_norm(x, params["final_norm"], c.rms_eps)
         head = params["embed"] if c.tie_embeddings else params["lm_head"]
-        logits = jnp.einsum("btd,vd->btv", x, head,
-                            preferred_element_type=jnp.float32)
+        logits = llama.head_matmul(x, head)   # plain bf16 or int8 {q,s} head
         logits = jnp.where(p == n_stages - 1, logits, 0.0)
         logits = jax.lax.psum(logits, "pipe")
         return logits, cache_k, cache_v

@@ -38,6 +38,25 @@ def _axis(mesh: Mesh, name: str, dim_size: int) -> str | None:
 
 # param path (dot key) → function(shape, mesh) -> PartitionSpec
 def _spec_for(path: str, shape: tuple[int, ...], mesh: Mesh) -> P:
+    # Int8-quantized weights (models/quant.py) add ".q"/".s" sub-leaves:
+    # the int8 tensor shards exactly like the bf16 weight it replaces; the
+    # per-output-channel scale shards like the weight's output dim (so a
+    # column-parallel matmul keeps scale shards co-resident with their
+    # channels, and a row-parallel one keeps the scale replicated — the
+    # fp32 rescale commutes with the int32 partial-sum all-reduce).
+    if path.endswith(".q"):
+        return _spec_for(path[:-2], shape, mesh)
+    if path.endswith(".s"):
+        base = path[:-2]
+        if base == "lm_head":                       # [V]
+            return P(_axis(mesh, "model", shape[0]))
+        key = base.split(".", 1)[1] if base.startswith("layers.") else base
+        lp = _axis(mesh, "pipe", shape[0])
+        if key in ("wq", "wk", "wv", "wg", "wu"):   # column-parallel [L, out]
+            return P(lp, _axis(mesh, "model", shape[1]))
+        if key in ("wo", "wd"):                     # row-parallel: out replicated
+            return P(lp, None)
+        return P()
     if path == "embed" or path == "lm_head":
         return P(_axis(mesh, "model", shape[0]), None)
     if path in ("final_norm",):
