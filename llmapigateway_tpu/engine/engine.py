@@ -514,10 +514,17 @@ class InferenceEngine:
             # about decode_burst tokens (comparable pacing to normal mode).
             self._spec_scan_len = max(
                 1, self.decode_burst // (self.spec_k + 1))
+            # The verify forward (T=k+1) defers its cache writes like
+            # decode does — the chunk path's per-layer functional insert
+            # costs ~2 ms/step in serialized scatters (tools/
+            # profile_insert.py), paid EVERY spec step otherwise.
+            spec_forward = partial(
+                family_forward,
+                attention_fn=_spec_verify_attention_fn(attention_fn))
             self._spec_scan = make_spec_burst(
-                model_forward, c, self.spec_k, self._spec_scan_len)
+                spec_forward, c, self.spec_k, self._spec_scan_len)
             self._spec_step = partial(jax.jit, donate_argnums=(1,))(
-                make_spec_step(model_forward, c, self.spec_k))
+                make_spec_step(spec_forward, c, self.spec_k))
 
     def _resolve_attention_impl(self) -> str:
         """Validate cfg.attention and resolve "auto" (pallas on real TPU;
@@ -1440,6 +1447,24 @@ def _pipelined_family_forward(mesh, n_stages: int):
                                  M, active=active)
 
     return fwd
+
+
+def _spec_verify_attention_fn(base):
+    """Attention provider for the speculative verify forward: the engine's
+    configured attention (``base``; None = family default), extended with
+    ``.verify`` so the T=k+1 verify step runs deferred-insert block
+    attention (llama.dense_verify_attention) instead of the chunk path's
+    insert-then-attend. A separate provider — adding ``.verify`` to the
+    shared one would silently reroute PREFILL chunks off the Pallas causal
+    kernel too (llama.forward dispatches on the attribute for any T>1)."""
+    base = base if base is not None else llama.dense_cache_attention
+
+    def attn(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
+        return base(q, k_new, v_new, layer_k, layer_v, lengths, active)
+    attn.verify = llama.dense_verify_attention
+    attn.decode = getattr(base, "decode", llama.dense_decode_attention)
+    attn.insert_all = getattr(base, "insert_all", llama.insert_kv_stacked)
+    return attn
 
 
 def _seq_prefill_attention_fn(mesh, kind: str = "ring"):

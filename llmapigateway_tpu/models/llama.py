@@ -270,6 +270,59 @@ def dense_decode_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     return out.reshape(B, 1, H * Dh).astype(q.dtype)
 
 
+def dense_verify_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
+                           layer_k: jax.Array, layer_v: jax.Array,
+                           lengths: jax.Array,
+                           active: jax.Array | None = None) -> jax.Array:
+    """Deferred-insert BLOCK attention: T new tokens attend the STALE cache
+    prefix ``[0, lengths)`` plus a causal self-block of themselves — the
+    T>1 generalization of :func:`dense_decode_attention` (T=1 self-column).
+
+    Mathematically identical to insert-then-attend over ``[0, lengths+T)``,
+    but with no cache write inside the layer scan: the speculative verify
+    step (engine/speculative.py, T = k+1) otherwise pays the chunk path's
+    per-layer serialized scatters every step. Two-piece online softmax,
+    clean S-reductions under GSPMD (same rationale as the decode twin).
+
+    q [B,T,H,Dh]; k_new/v_new [B,T,KV,Dh]; layer_k/v [B,KV,S,Dh] (stale).
+    Returns out [B, T, H*Dh]; writes nothing.
+    """
+    B, T, H, Dh = q.shape
+    KV = k_new.shape[2]
+    S = layer_k.shape[2]
+    G = H // KV
+    scale = Dh ** -0.5
+
+    qg = q.reshape(B, T, KV, G, Dh).transpose(0, 2, 3, 1, 4)  # [B,KV,G,T,Dh]
+    kn = k_new.transpose(0, 2, 1, 3)                          # [B,KV,T,Dh]
+    vn = v_new.transpose(0, 2, 1, 3).astype(jnp.float32)
+    scores = jnp.einsum("bkgtd,bksd->bkgts", qg, layer_k,
+                        preferred_element_type=jnp.float32) * scale
+    self_s = jnp.einsum("bkgtd,bkud->bkgtu", qg, kn,
+                        preferred_element_type=jnp.float32) * scale
+
+    visible = jnp.arange(S)[None, :] < lengths[:, None]            # [B, S]
+    if active is not None:
+        visible = visible & active[:, None]
+    scores = jnp.where(visible[:, None, None, None, :], scores, -1e30)
+    # Self-block: new token u is visible to query t iff u <= t (the query
+    # itself is always visible, so the softmax denominator is >= 1).
+    causal = (jnp.arange(T)[None, :] <= jnp.arange(T)[:, None])    # [T, T]
+    self_s = jnp.where(causal[None, None, None], self_s, -1e30)
+
+    m = jnp.maximum(jnp.max(scores, axis=-1), jnp.max(self_s, axis=-1))
+    p = jnp.exp(scores - m[..., None])                      # [B,KV,G,T,S]
+    p_self = jnp.exp(self_s - m[..., None])                 # [B,KV,G,T,T]
+    l = jnp.sum(p, axis=-1) + jnp.sum(p_self, axis=-1)
+    out = jnp.einsum("bkgts,bksd->bkgtd", p.astype(layer_v.dtype), layer_v,
+                     preferred_element_type=jnp.float32)
+    out = out + jnp.einsum("bkgtu,bkud->bkgtd", p_self, vn)
+    out = out / l[..., None]
+    # [B,KV,G,T,Dh] → [B,T,H*Dh]
+    out = out.transpose(0, 3, 1, 2, 4).reshape(B, T, H * Dh)
+    return out.astype(q.dtype)
+
+
 def dense_cache_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
                           layer_k: jax.Array, layer_v: jax.Array,
                           lengths: jax.Array,
@@ -380,14 +433,18 @@ def forward(params: Params, config: ModelConfig, tokens: jax.Array,
     layer_params = params["layers"]
     custom_mlp = mlp_fn
 
-    # Deferred-insert decode protocol: an attention_fn may carry a
-    # ``.decode`` (stale-cache + self-column attention, NO cache write) and
-    # an ``.insert_all`` (one stacked insert for every layer's new token).
-    # For T == 1 this keeps the full-extent cache OUT of the layer scan's
-    # ys — the per-layer functional cache update costs ~2 ms/step in
-    # serialized scatters at L=22 (tools/profile_insert.py); the deferred
-    # form stacks only the tiny [L,B,1,KV,Dh] new tokens and inserts once.
-    decode_attend = getattr(attention_fn, "decode", None) if T == 1 else None
+    # Deferred-insert protocol: an attention_fn may carry a ``.decode``
+    # (T=1: stale-cache + self-column attention, NO cache write), a
+    # ``.verify`` (T>1 twin with a causal self-block — the speculative
+    # verify path), and an ``.insert_all`` (one stacked insert for every
+    # layer's new tokens). This keeps the full-extent cache OUT of the
+    # layer scan's ys — the per-layer functional cache update costs
+    # ~2 ms/step in serialized scatters at L=22 (tools/profile_insert.py);
+    # the deferred form stacks only the tiny [L,B,T,KV,Dh] new tokens and
+    # inserts once. Providers WITHOUT ``.verify`` (the prefill chunk path,
+    # Pallas causal kernels) keep insert-then-attend for T>1.
+    decode_attend = getattr(attention_fn, "decode", None) if T == 1 else \
+        getattr(attention_fn, "verify", None)
 
     def layer_step(x, scanned):
         lp, layer_k, layer_v = scanned

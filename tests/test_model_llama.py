@@ -82,6 +82,43 @@ def test_decode_matches_prefill_logits(setup):
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
 
+def test_verify_attention_matches_insert_then_attend(setup):
+    """dense_verify_attention (deferred-insert T-block, spec verify path)
+    must equal the chunk path's insert-then-attend on the same T tokens —
+    both attention outputs and the cache left by insert_kv_stacked."""
+    cfg, params = setup
+    B, T, S, P = 2, 4, 32, 11
+    key = jax.random.PRNGKey(3)
+    ids = jax.random.randint(key, (B, P + T), 0, cfg.vocab_size)
+    lengths0 = jnp.zeros((B,), jnp.int32)
+    active = jnp.ones((B,), bool)
+
+    # Prefill P tokens, then the T-token block via the chunk path.
+    cache_a = llama.KVCache.create(cfg, B, S, dtype=jnp.float32)
+    _, cache_a = llama.forward(params, cfg, ids[:, :P], lengths0, cache_a)
+    logits_a, cache_a = llama.forward(
+        params, cfg, ids[:, P:], jnp.full((B,), P, jnp.int32), cache_a,
+        active=active)
+
+    # Same block via a verify-capable provider (deferred insert).
+    verify_attn = lambda *a, **kw: llama.dense_cache_attention(*a, **kw)
+    verify_attn.verify = llama.dense_verify_attention
+    verify_attn.decode = llama.dense_decode_attention
+    verify_attn.insert_all = llama.insert_kv_stacked
+    cache_b = llama.KVCache.create(cfg, B, S, dtype=jnp.float32)
+    _, cache_b = llama.forward(params, cfg, ids[:, :P], lengths0, cache_b)
+    logits_b, cache_b = llama.forward(
+        params, cfg, ids[:, P:], jnp.full((B,), P, jnp.int32), cache_b,
+        active=active, attention_fn=verify_attn)
+
+    np.testing.assert_allclose(np.asarray(logits_b), np.asarray(logits_a),
+                               rtol=2e-4, atol=2e-4)
+    for got, want in ((cache_b.k, cache_a.k), (cache_b.v, cache_a.v)):
+        np.testing.assert_allclose(np.asarray(got[:, :, :, :P + T]),
+                                   np.asarray(want[:, :, :, :P + T]),
+                                   rtol=2e-4, atol=2e-4)
+
+
 def test_padding_tokens_do_not_corrupt(setup):
     """Pad tokens beyond the true length must not change real logits (the
     bucketed-prefill invariant)."""
