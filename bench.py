@@ -122,6 +122,18 @@ def _other_python_procs() -> list[str]:
 
 def build_engine(args, kv_layout: str, preset: str | None = None,
                  batch: int | None = None, quant: str = ""):
+    import logging
+    # The engine logs its init phase breakdown (params-ready seconds etc.)
+    # at INFO — surface it so a slow cold start is attributable from the
+    # bench log alone (param init/upload vs XLA compile vs cache hit).
+    # Package logger only: a root-level basicConfig would mislabel every
+    # third-party INFO record as "[engine]".
+    pkg = logging.getLogger("llmapigateway_tpu")
+    if not pkg.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter("[engine] %(message)s"))
+        pkg.addHandler(h)
+        pkg.setLevel(logging.INFO)
     from llmapigateway_tpu.config.schemas import LocalEngineConfig
     from llmapigateway_tpu.engine.engine import InferenceEngine
     cfg = LocalEngineConfig(

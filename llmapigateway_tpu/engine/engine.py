@@ -276,9 +276,14 @@ class InferenceEngine:
         self._enable_debug_nans()
         _enable_compilation_cache(engine_cfg.compilation_cache_dir)
 
+        t0 = time.monotonic()
         self._init_params()
+        t1 = time.monotonic()
         self._init_state()
         self._compile()
+        logger.info("engine build: params %.1fs, state+programs %.1fs "
+                    "(programs compile lazily on first call)",
+                    t1 - t0, time.monotonic() - t1)
 
         self._queue: asyncio.Queue[GenRequest] = asyncio.Queue(
             maxsize=max(2 * self.B, 16))
@@ -320,13 +325,24 @@ class InferenceEngine:
                                           dtype=self.dtype, put=put,
                                           preprocess=preprocess)
         else:
+            # Random init as ONE jitted program with sharded outputs:
+            # params materialize directly in their GSPMD layout (no host
+            # copy, no host→device transfer), and the whole init lands in
+            # the persistent compilation cache — the eager per-op form
+            # compiled ~10 one-off programs on every cold start. Multihost:
+            # same program + same key on every process → identical values,
+            # each process computing only its addressable shards.
+            def build(k):
+                p = init_fn(c)(c, k, dtype=self.dtype)
+                if self.quant == "int8":
+                    from ..models.quant import quantize_tree
+                    p = quantize_tree(p, c)
+                return p
             key = jax.random.PRNGKey(0)
-            host_params = init_fn(c)(c, key, dtype=self.dtype)
-            if self.quant == "int8":
-                from ..models.quant import quantize_tree
-                host_params = quantize_tree(host_params, c)
-            shardings = param_shardings(host_params, self.mesh)
-            self.params = jax.tree.map(put_global, host_params, shardings)
+            shapes = jax.eval_shape(build, key)
+            shardings = param_shardings(shapes, self.mesh)
+            self.params = jax.jit(build, out_shardings=shardings)(key)
+            jax.block_until_ready(self.params)
         n_params = sum(int(np.prod(p.shape))
                        for p in jax.tree.leaves(self.params))
         logger.info("params ready: %.2fB parameters in %.1fs",
@@ -1599,6 +1615,14 @@ def _config_from_checkpoint(model_path: str) -> ModelConfig:
                            **common)
     if mtype == "qwen2":
         return ModelConfig(family="qwen2", attn_bias=True, **common)
+    if mtype == "gemma":
+        # Gemma always ties embeddings (HF omits the flag in some configs)
+        # and carries an explicit head_dim (7B: 16 x 256 != hidden 3072).
+        common["tie_embeddings"] = True
+        return ModelConfig(family="gemma", act="gelu_tanh", rms_offset=1.0,
+                           scale_embed=True,
+                           head_dim_override=cfg.get("head_dim", 0),
+                           **common)
     return ModelConfig(family="llama", **common)
 
 

@@ -34,7 +34,7 @@ class RopeScaling:
 
 @dataclass(frozen=True)
 class ModelConfig:
-    family: str = "llama"          # "llama" | "qwen2" | "mixtral"
+    family: str = "llama"          # "llama" | "qwen2" | "gemma" | "mixtral"
     vocab_size: int = 32000
     d_model: int = 2048
     n_layers: int = 22
@@ -48,13 +48,21 @@ class ModelConfig:
     tie_embeddings: bool = False
     # QKV projection bias (Qwen2-family); the rest of the block is llama.
     attn_bias: bool = False
+    # Gemma-family block variations (all config-driven — the llama forward
+    # is the single implementation):
+    act: str = "silu"              # MLP gate activation: "silu" | "gelu_tanh"
+    rms_offset: float = 0.0        # RMSNorm weight offset: x * (offset + w)
+    scale_embed: bool = False      # multiply embeddings by sqrt(d_model)
+    # Explicit head dim for families where H * Dh != d_model (Gemma-7B:
+    # 16 heads x 256 vs d_model 3072). 0 = derive d_model // n_heads.
+    head_dim_override: int = 0
     # MoE (mixtral) fields
     n_experts: int = 0             # 0 → dense
     experts_per_token: int = 2
 
     @property
     def head_dim(self) -> int:
-        return self.d_model // self.n_heads
+        return self.head_dim_override or self.d_model // self.n_heads
 
     @property
     def is_moe(self) -> bool:
@@ -70,6 +78,11 @@ PRESETS: dict[str, ModelConfig] = {
         family="qwen2", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
         n_kv_heads=2, d_ff=128, max_seq_len=256, tie_embeddings=True,
         attn_bias=True),
+    "tiny-gemma-test": ModelConfig(
+        family="gemma", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
+        n_kv_heads=1, d_ff=128, max_seq_len=256, tie_embeddings=True,
+        act="gelu_tanh", rms_offset=1.0, scale_embed=True,
+        head_dim_override=16, rms_eps=1e-6),
     "tiny-moe-test": ModelConfig(
         family="mixtral", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
         n_kv_heads=2, d_ff=128, max_seq_len=256, n_experts=4,
@@ -101,6 +114,21 @@ PRESETS: dict[str, ModelConfig] = {
     "llama-3-70b": ModelConfig(
         vocab_size=128256, d_model=8192, n_layers=80, n_heads=64,
         n_kv_heads=8, d_ff=28672, rope_theta=500000.0, max_seq_len=8192),
+    # Gemma-2B (HF: google/gemma-2b): MQA (1 KV head), head_dim 256,
+    # GeGLU MLP, (1+w) RMSNorm, sqrt(D)-scaled tied embeddings.
+    "gemma-2b": ModelConfig(
+        family="gemma", vocab_size=256000, d_model=2048, n_layers=18,
+        n_heads=8, n_kv_heads=1, d_ff=16384, rope_theta=10000.0,
+        rms_eps=1e-6, max_seq_len=8192, tie_embeddings=True,
+        act="gelu_tanh", rms_offset=1.0, scale_embed=True,
+        head_dim_override=256),
+    # Gemma-7B (HF: google/gemma-7b): 16 heads x 256 > d_model 3072.
+    "gemma-7b": ModelConfig(
+        family="gemma", vocab_size=256000, d_model=3072, n_layers=28,
+        n_heads=16, n_kv_heads=16, d_ff=24576, rope_theta=10000.0,
+        rms_eps=1e-6, max_seq_len=8192, tie_embeddings=True,
+        act="gelu_tanh", rms_offset=1.0, scale_embed=True,
+        head_dim_override=256),
     # Mixtral-8x7B (HF: mistralai/Mixtral-8x7B-Instruct-v0.1).
     "mixtral-8x7b": ModelConfig(
         family="mixtral", vocab_size=32000, d_model=4096, n_layers=32,

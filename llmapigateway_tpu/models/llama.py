@@ -91,12 +91,15 @@ def init_params(config: ModelConfig, key: jax.Array,
 # Building blocks
 # ---------------------------------------------------------------------------
 
-def rms_norm(x: jax.Array, weight: jax.Array, eps: float) -> jax.Array:
-    """RMSNorm with fp32 accumulation (bf16 variance underflows)."""
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float,
+             offset: float = 0.0) -> jax.Array:
+    """RMSNorm with fp32 accumulation (bf16 variance underflows).
+    ``offset``: Gemma parameterizes the scale as ``(1 + w)`` (HF
+    GemmaRMSNorm); llama/qwen2 use plain ``w`` (offset 0)."""
     xf = x.astype(jnp.float32)
     var = jnp.mean(xf * xf, axis=-1, keepdims=True)
     normed = xf * jax.lax.rsqrt(var + eps)
-    return (normed * weight.astype(jnp.float32)).astype(x.dtype)
+    return (normed * (offset + weight.astype(jnp.float32))).astype(x.dtype)
 
 
 def rope_tables(positions: jax.Array, head_dim: int, theta: float,
@@ -377,11 +380,18 @@ dense_cache_attention.decode = dense_decode_attention
 dense_cache_attention.insert_all = insert_kv_stacked
 
 
+_GATE_ACTS = {
+    "silu": jax.nn.silu,                                      # llama/qwen2
+    "gelu_tanh": partial(jax.nn.gelu, approximate=True),      # gemma GeGLU
+}
+
+
 def swiglu_mlp(x: jax.Array, wg: jax.Array, wu: jax.Array,
-               wd: jax.Array) -> jax.Array:
-    """Each weight is a plain array or an int8 ``{"q","s"}`` dict
+               wd: jax.Array, act: str = "silu") -> jax.Array:
+    """Gated MLP (SwiGLU for llama/qwen2, GeGLU for gemma via ``act``).
+    Each weight is a plain array or an int8 ``{"q","s"}`` dict
     (models/quant.py) — ``mm`` dispatches."""
-    gate = jax.nn.silu(mm(x, wg))
+    gate = _GATE_ACTS[act](mm(x, wg))
     return mm(gate * mm(x, wu), wd)
 
 
@@ -426,6 +436,10 @@ def forward(params: Params, config: ModelConfig, tokens: jax.Array,
     dh = c.head_dim
 
     x = jnp.take(params["embed"], tokens, axis=0)   # [B, T, D]
+    if c.scale_embed:
+        # Gemma scales embeddings by sqrt(D) *in the model dtype* (HF casts
+        # the normalizer to hidden-state dtype — match its rounding).
+        x = x * jnp.asarray(c.d_model ** 0.5, x.dtype)
 
     positions = lengths[:, None] + jnp.arange(T)[None, :]       # [B, T]
     cos, sin = rope_tables(positions, dh, c.rope_theta, c.rope_scaling)
@@ -449,7 +463,7 @@ def forward(params: Params, config: ModelConfig, tokens: jax.Array,
     def layer_step(x, scanned):
         lp, layer_k, layer_v = scanned
         # Attention block
-        h = rms_norm(x, lp["attn_norm"], c.rms_eps)
+        h = rms_norm(x, lp["attn_norm"], c.rms_eps, c.rms_offset)
         q, k, v = qkv_proj(h, lp, c)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
@@ -462,11 +476,11 @@ def forward(params: Params, config: ModelConfig, tokens: jax.Array,
             ys = (layer_k, layer_v)
         x = x + mm(attn, lp["wo"])
         # MLP block
-        h = rms_norm(x, lp["mlp_norm"], c.rms_eps)
+        h = rms_norm(x, lp["mlp_norm"], c.rms_eps, c.rms_offset)
         if custom_mlp is not None:
             x = x + custom_mlp(h, lp)
         else:
-            x = x + swiglu_mlp(h, lp["wg"], lp["wu"], lp["wd"])
+            x = x + swiglu_mlp(h, lp["wg"], lp["wu"], lp["wd"], c.act)
         return x, ys
 
     x, (ys_k, ys_v) = jax.lax.scan(
@@ -477,7 +491,7 @@ def forward(params: Params, config: ModelConfig, tokens: jax.Array,
     else:
         new_k, new_v = ys_k, ys_v
 
-    x = rms_norm(x, params["final_norm"], c.rms_eps)
+    x = rms_norm(x, params["final_norm"], c.rms_eps, c.rms_offset)
     head = params["embed"] if c.tie_embeddings else params["lm_head"]
     # bf16 (or int8) reads of the [V, D] head with MXU accumulation — an
     # explicit astype would materialize a fp32 copy of the vocab matrix.

@@ -62,15 +62,15 @@ def _block_forward(lp_block: dict, c: ModelConfig, x: jax.Array,
 
     def layer_step(x, scanned):
         lp, layer_k, layer_v = scanned
-        h = llama.rms_norm(x, lp["attn_norm"], c.rms_eps)
+        h = llama.rms_norm(x, lp["attn_norm"], c.rms_eps, c.rms_offset)
         q, k, v = llama.qkv_proj(h, lp, c)
         q = llama.apply_rope(q, cos, sin)
         k = llama.apply_rope(k, cos, sin)
         attn, layer_k, layer_v = llama.dense_cache_attention(
             q, k, v, layer_k, layer_v, lengths, active)
         x = x + llama.mm(attn, lp["wo"])
-        h = llama.rms_norm(x, lp["mlp_norm"], c.rms_eps)
-        x = x + llama.swiglu_mlp(h, lp["wg"], lp["wu"], lp["wd"])
+        h = llama.rms_norm(x, lp["mlp_norm"], c.rms_eps, c.rms_offset)
+        x = x + llama.swiglu_mlp(h, lp["wg"], lp["wu"], lp["wd"], c.act)
         return x, (layer_k, layer_v)
 
     x, (new_k, new_v) = jax.lax.scan(layer_step, x, (lp_block, k_block, v_block))
@@ -107,6 +107,8 @@ def _build_run(c: ModelConfig, mesh: Mesh, n_stages: int, M: int, Bm: int,
         # Every stage embeds every microbatch (replicated compute, tiny):
         # [M, Bm, T, D].
         x_all = jnp.take(params["embed"], tokens, axis=0).reshape(M, Bm, T, -1)
+        if c.scale_embed:
+            x_all = x_all * jnp.asarray(c.d_model ** 0.5, x_all.dtype)
         positions = (lengths[:, None] + jnp.arange(T)[None, :])     # [B, T]
         cos_all, sin_all = llama.rope_tables(positions, c.head_dim,
                                              c.rope_theta, c.rope_scaling)
@@ -158,7 +160,7 @@ def _build_run(c: ModelConfig, mesh: Mesh, n_stages: int, M: int, Bm: int,
         # Final norm + head on the last stage's collected activations;
         # masked psum broadcasts the logits to every stage.
         x = outs.reshape(B, T, -1)
-        x = llama.rms_norm(x, params["final_norm"], c.rms_eps)
+        x = llama.rms_norm(x, params["final_norm"], c.rms_eps, c.rms_offset)
         head = params["embed"] if c.tie_embeddings else params["lm_head"]
         logits = llama.head_matmul(x, head)   # plain bf16 or int8 {q,s} head
         logits = jnp.where(p == n_stages - 1, logits, 0.0)
