@@ -273,6 +273,9 @@ def fill_and_time_decode(engine, args, steps: int | None = None) -> dict:
 def reset_slots(engine) -> None:
     """Return a bench-filled engine to a clean scheduler state."""
     engine._pending = None               # drop any in-flight burst
+    if engine.spec_k:
+        engine._spec_pending = None
+        engine._d_hist_fresh = False
     engine.lengths[:] = 0
     engine.active[:] = False
     engine.last_token[:] = 0

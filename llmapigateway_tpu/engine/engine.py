@@ -418,6 +418,7 @@ class InferenceEngine:
             self.hist = np.zeros((self.B, self.S), np.int32)
             self._d_hist = None
             self._d_hist_fresh = False
+            self._spec_pending = None       # lag-one in-flight spec burst
             self._spec_steps_done = 0
             self._spec_tokens_out = 0
 
@@ -899,13 +900,18 @@ class InferenceEngine:
             # unaccelerated.
             spec_now = self.spec_k and not bool(
                 np.any(self.samp_temperature[self.active] > 0))
+            # While a spec burst is in flight (lag-one), the host lengths
+            # lag dispatch by a data-dependent amount — cap against the
+            # worst case (every in-flight step fully accepted).
+            inflight = self._spec_inflight_advance() if self.spec_k else 0
             if spec_now:
                 # A slot whose dispatch-true length is within k of the
                 # cache extent can't fit a k+1-wide verify (possible when
                 # lag-one normal bursts ran it ahead of emission): fall
                 # back to the 1-wide normal path until emission retires it.
                 spec_now = all(
-                    self.S - int(self.lengths[r.slot]) >= self.spec_k + 1
+                    self.S - (int(self.lengths[r.slot]) + inflight)
+                    >= self.spec_k + 1
                     for r in decoding)
             if spec_now:
                 # Speculative steps advance 1..k+1 positions each; cap so a
@@ -914,9 +920,9 @@ class InferenceEngine:
                 kp1 = self.spec_k + 1
                 burst = 1 if busy else self._spec_scan_len
                 for r in decoding:
-                    room = (self.S - int(self.lengths[r.slot])) // kp1
-                    dispatched = (int(self.lengths[r.slot])
-                                  - len(r.prompt_ids) + 1)
+                    ub = int(self.lengths[r.slot]) + inflight
+                    room = (self.S - ub) // kp1
+                    dispatched = ub - len(r.prompt_ids) + 1
                     left = max(1, r.max_tokens - dispatched)
                     burst = min(burst, max(1, room), -(-left // kp1))
                 step_tokens = await asyncio.to_thread(
@@ -927,12 +933,14 @@ class InferenceEngine:
                 # budget — both computed from DISPATCH-TRUE state
                 # (self.lengths advances at dispatch): with lag-one
                 # pipelining, len(r.generated) lags a burst behind and
-                # would let a whole discarded burst through.
+                # would let a whole discarded burst through. `inflight`
+                # covers a pending spec burst (mode switch): its
+                # data-dependent advance lands on the host mirrors inside
+                # _decode_burst, AFTER these caps are computed.
                 for r in decoding:
-                    dispatched = (int(self.lengths[r.slot])
-                                  - len(r.prompt_ids) + 1)
-                    burst = min(burst,
-                                self.S - int(self.lengths[r.slot]),
+                    ub = int(self.lengths[r.slot]) + inflight
+                    dispatched = ub - len(r.prompt_ids) + 1
+                    burst = min(burst, self.S - ub,
                                 max(1, r.max_tokens - dispatched))
                 burst = max(1, burst)
                 step_tokens = await asyncio.to_thread(
@@ -1108,23 +1116,40 @@ class InferenceEngine:
 
     def _spec_burst(self, n_steps: int) -> list[np.ndarray]:
         """Run `n_steps` speculative draft+verify steps (engine/
-        speculative.py) and sync host mirrors EXACTLY from the fetched
-        emitted-token matrix — speculative advances are data-dependent
-        (1..k+1 positions per step), so this path is synchronous rather
-        than lag-one pipelined. Returns emission-ready [B] token rows with
-        -1 beyond each slot's accepted count (the emission loop's existing
-        negative-token skip handles raggedness)."""
+        speculative.py). Full-size bursts run LAG-ONE pipelined like the
+        normal path: this call dispatches burst N (device-side hist/token/
+        length state chains between bursts) and returns burst N-1's rows,
+        hiding the device→host round trip under compute. Host mirrors sync
+        EXACTLY at flush time from the fetched emitted-token matrix —
+        speculative advances are data-dependent (1..k+1 positions/step),
+        so while a burst is in flight the host `lengths` lag dispatch and
+        the scheduler caps against `_spec_inflight_advance()`'s upper
+        bound. Returns emission-ready [B] token rows with -1 beyond each
+        slot's accepted count (the emission loop's negative-token skip
+        handles raggedness)."""
         if self.fault_plan:
             self.fault_plan.on_decode()
         # A mixed-mode engine may have a normal burst in flight (the batch
         # just turned all-greedy): land it first so mirrors are exact.
         pre = self._flush_pending()
         if self._d_dirty or not self._d_hist_fresh:
+            # Upload needs exact host mirrors — land any in-flight spec
+            # burst before reading them.
+            pre += self._flush_spec_pending()
             rep = NamedSharding(self.mesh, P())
             self._d_tokens = jax.device_put(self.last_token, rep)
             self._d_lengths = jax.device_put(self.lengths, rep)
             self._d_active = jax.device_put(self.active, rep)
             self._d_hist = jax.device_put(self.hist, rep)
+            # Sampler mirrors too: this branch clears _d_dirty, and a later
+            # spec→normal mode switch (e.g. the cache-end fallback) must
+            # not hand _decode_burst a never-built _d_samp — a None there
+            # retraces the decode program with a different pytree structure
+            # (full XLA compile mid-serving).
+            self._d_samp = SamplingParams(
+                temperature=jax.device_put(self.samp_temperature, rep),
+                top_p=jax.device_put(self.samp_top_p, rep),
+                top_k=jax.device_put(self.samp_top_k, rep))
             self._d_dirty = False
             self._d_hist_fresh = True
 
@@ -1134,29 +1159,66 @@ class InferenceEngine:
                 self._d_lengths = self._spec_scan(
                     self.params, self.cache, *table, self._d_hist,
                     self._d_tokens, self._d_lengths, self._d_active)
-            host = np.asarray(emitted)                  # [n, B, k+1]
-        else:
-            outs = []
-            for _ in range(n_steps):
-                self._d_tokens, self._d_lengths, self.cache, self._d_hist, \
-                    em, _ = self._spec_step(
-                        self.params, self.cache, *table, self._d_hist,
-                        self._d_tokens, self._d_lengths, self._d_active)
-                try:
-                    em.copy_to_host_async()
-                except Exception:       # backend without async copies
-                    pass
-                outs.append(em)
-            host = np.stack([np.asarray(e) for e in outs])
+            try:
+                emitted.copy_to_host_async()
+            except Exception:           # backend without async copies
+                pass
+            prev, self._spec_pending = self._spec_pending, (
+                emitted, n_steps, self.active.copy(),
+                self._slot_epoch.copy())
+            return pre + self._flush_spec_entry(prev)
 
+        # Partial bursts (cache/budget caps, busy depth 1) stay
+        # synchronous: land the in-flight burst, then step one at a time.
+        pre += self._flush_spec_pending()
+        outs = []
+        for _ in range(n_steps):
+            self._d_tokens, self._d_lengths, self.cache, self._d_hist, \
+                em, _ = self._spec_step(
+                    self.params, self.cache, *table, self._d_hist,
+                    self._d_tokens, self._d_lengths, self._d_active)
+            try:
+                em.copy_to_host_async()
+            except Exception:           # backend without async copies
+                pass
+            outs.append(em)
+        host = np.stack([np.asarray(e) for e in outs])
+        return pre + self._spec_walk(host, self.active, self.active.copy())
+
+    def _spec_inflight_advance(self) -> int:
+        """Upper bound on cache positions an in-flight speculative burst
+        may still add per slot (every step fully accepted). The scheduler's
+        burst caps add this to the host `lengths` mirror, which lags
+        dispatch while a spec burst is pending."""
+        if self._spec_pending is None:
+            return 0
+        return self._spec_pending[1] * (self.spec_k + 1)
+
+    def _flush_spec_pending(self) -> list[np.ndarray]:
+        entry, self._spec_pending = self._spec_pending, None
+        return self._flush_spec_entry(entry)
+
+    def _flush_spec_entry(self, entry) -> list[np.ndarray]:
+        """Fetch an in-flight spec burst's emitted matrix and sync host
+        mirrors exactly. The walk starts from the CURRENT host mirrors:
+        bursts flush in dispatch order, so at flush time they are exact
+        through the previous burst; slots released (or re-admitted) since
+        dispatch are excluded by the epoch guard and their rows masked."""
+        if entry is None:
+            return []
+        emitted, _, active_snap, epoch_snap = entry
+        host = np.asarray(emitted)                       # [n, B, k+1]
+        live = active_snap & (epoch_snap == self._slot_epoch)
+        return self._spec_walk(host, active_snap, live)
+
+    def _spec_walk(self, host: np.ndarray, active_snap: np.ndarray,
+                   live: np.ndarray) -> list[np.ndarray]:
+        """Exact host-mirror walk (lengths / last_token / history): each
+        step's valid inputs are [current token] + accepted drafts, i.e.
+        [cur] + emitted[:count-1]; the step's last emitted token becomes
+        the next input. Returns emission rows (dead slots masked -1)."""
         kp1 = self.spec_k + 1
-        rows = [host[i, :, t] for i in range(host.shape[0])
-                for t in range(kp1)]
-        # Exact host-mirror walk (lengths / last_token / history): each
-        # step's valid inputs are [current token] + accepted drafts, i.e.
-        # [cur] + emitted[:count-1]; the step's last emitted token becomes
-        # the next input.
-        for slot in np.nonzero(self.active)[0]:
+        for slot in np.nonzero(live)[0]:
             pos = int(self.lengths[slot])
             cur = int(self.last_token[slot])
             for i in range(host.shape[0]):
@@ -1173,9 +1235,13 @@ class InferenceEngine:
                 pos += count
             self.lengths[slot] = pos
             self.last_token[slot] = cur
-        self._spec_steps_done += host.shape[0] * int(self.active.sum())
+        if not live.all():
+            host = host.copy()
+            host[:, ~live] = -1
+        self._spec_steps_done += host.shape[0] * int(active_snap.sum())
         self._spec_tokens_out += int((host >= 0).sum())
-        return pre + rows
+        return [host[i, :, t] for i in range(host.shape[0])
+                for t in range(kp1)]
 
     def _flush_pending(self) -> list[np.ndarray]:
         """Fetch the in-flight burst's tokens (if any) and sync the host
@@ -1243,11 +1309,16 @@ class InferenceEngine:
             return step_tokens
 
         pre: list[np.ndarray] = []
+        if self.spec_k:
+            # Mode switch (a sampled request joined): land any in-flight
+            # SPECULATIVE burst first — its data-dependent advances must
+            # reach the host mirrors before this path reads/advances them.
+            pre += self._flush_spec_pending()
         if self._d_dirty:
             # Host slot state changed (admission/release/prefill). The
             # in-flight burst must land first: the upload below reads the
             # host `last_token` mirror, which that burst's tokens update.
-            pre = self._flush_pending()
+            pre += self._flush_pending()
             # Upload once, pinned to the SAME replicated sharding the
             # compiled programs produce — a plain jnp.asarray upload
             # carries SingleDeviceSharding while the program outputs fed

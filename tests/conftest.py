@@ -24,6 +24,13 @@ if not _TPU_SMOKE:
     _flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in _flags:
         os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+    # Keep the persistent XLA compilation cache OUT of the user cache dir:
+    # tests run with different XLA flags than serving processes, and
+    # cross-process AOT reloads with mismatched feature sets warn (or
+    # SIGILL). Engines built by tests inherit this env default.
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.environ.get("TMPDIR", "/tmp"), "llmgw-test-xla-cache"))
 
 import jax  # noqa: E402
 

@@ -150,6 +150,57 @@ async def test_spec_engine_serves_sampled_via_normal_path():
         await eng.stop()
 
 
+def test_spec_burst_lag_one_contract():
+    """Full-size spec bursts are lag-one pipelined: call N dispatches
+    burst N and returns burst N-1's rows; a flush lands the in-flight
+    burst; host lengths advance by exactly the accepted token counts."""
+    eng = _engine(spec=3)
+    rngp = np.random.default_rng(3)
+    base = rngp.integers(2, 500, 8)
+    prompt = np.tile(base, 6).astype(np.int32)          # 48 tokens
+    for slot in range(eng.B):
+        for pos in range(0, len(prompt), eng.prefill_chunk):
+            first, eng.cache = eng._exec_prefill(
+                slot, pos, prompt[pos:pos + eng.prefill_chunk])
+        eng.lengths[slot] = len(prompt)
+        eng.active[slot] = True
+        eng.last_token[slot] = int(base[0])
+        eng.hist[slot, :len(prompt)] = prompt
+    np.asarray(first)
+    eng._d_dirty = True
+
+    n = eng._spec_scan_len
+    rows1 = eng._spec_burst(n)
+    assert rows1 == [] and eng._spec_pending is not None
+    rows2 = eng._spec_burst(n)                          # flushes burst 1
+    assert len(rows2) == n * (eng.spec_k + 1)
+    tail = eng._flush_spec_pending()                    # lands burst 2
+    assert len(tail) == n * (eng.spec_k + 1)
+    assert eng._spec_pending is None
+    accepted = sum(int((r >= 0).sum()) for r in rows2 + tail)
+    assert int(eng.lengths.sum()) == eng.B * len(prompt) + accepted
+
+
+async def test_spec_runs_to_cache_end_via_normal_fallback():
+    """A greedy generation that fills the cache must cross the spec→normal
+    fallback window (S - lengths - inflight < k+1) and still complete —
+    regression: the spec path's state upload once left the sampler
+    mirrors unbuilt, so this mode switch handed the decode program a
+    None sampler (full retrace mid-serving)."""
+    eng = _engine(spec=3)                         # S=192
+    rng = np.random.default_rng(7)
+    prompt = list(np.tile(rng.integers(2, 500, 6), 8))      # 48 tokens
+    try:
+        req = await _gen(eng, prompt, max_tokens=500)       # clamped to fit
+    finally:
+        await eng.stop()
+    assert req.finish_reason in ("length", "stop")
+    if req.finish_reason == "length":
+        # Spec engines reserve the last k+1 cache positions (a k+1-wide
+        # verify must never write past the extent): S - k - 1 - prompt.
+        assert len(req.generated) == 192 - eng.spec_k - 1 - 48
+
+
 def test_spec_config_guardrails():
     with pytest.raises(ValueError, match="1, 3, 7"):
         _engine(spec=4)
