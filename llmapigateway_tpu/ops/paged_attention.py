@@ -99,8 +99,12 @@ def paged_insert_kv(layer_k: jax.Array, layer_v: jax.Array,
     flat_k = k_new.reshape(B * T, KV, Dh).astype(layer_k.dtype)
     flat_v = v_new.reshape(B * T, KV, Dh).astype(layer_v.dtype)
     # [P, KV, page, Dh] scattered at (page, :, offset, :) per new token.
-    layer_k = layer_k.at[flat_page, :, flat_off].set(flat_k)
-    layer_v = layer_v.at[flat_page, :, flat_off].set(flat_v)
+    # In-bounds by construction (phys from the table or trash page 0;
+    # off = pos % page) — the mode hint drops XLA's per-element clamping.
+    layer_k = layer_k.at[flat_page, :, flat_off].set(
+        flat_k, mode="promise_in_bounds")
+    layer_v = layer_v.at[flat_page, :, flat_off].set(
+        flat_v, mode="promise_in_bounds")
     return layer_k, layer_v
 
 
@@ -132,9 +136,10 @@ def paged_insert_all(pool_k: jax.Array, pool_v: jax.Array,
     newk = k_news[:, :, 0].transpose(1, 0, 2, 3).astype(pool_k.dtype)
     newv = v_news[:, :, 0].transpose(1, 0, 2, 3).astype(pool_v.dtype)
     # Advanced indices (phys, off) are separated by slices, so the indexed
-    # result is [B, L, KV, Dh] — newk/newv match that layout.
-    pool_k = pool_k.at[:, phys, :, off].set(newk)
-    pool_v = pool_v.at[:, phys, :, off].set(newv)
+    # result is [B, L, KV, Dh] — newk/newv match that layout. In-bounds by
+    # construction (see paged_insert_kv).
+    pool_k = pool_k.at[:, phys, :, off].set(newk, mode="promise_in_bounds")
+    pool_v = pool_v.at[:, phys, :, off].set(newv, mode="promise_in_bounds")
     return pool_k, pool_v
 
 

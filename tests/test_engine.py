@@ -89,6 +89,43 @@ async def test_pipelined_bursts_match_sync_engine():
         assert len(piped.generated) <= mt
 
 
+async def test_tp_serving_engages_sharded_pallas_kernels(caplog):
+    """VERDICT r2 stretch item: on a multi-chip mesh with
+    attention="pallas", real serving must route through the shard_map'd
+    flash kernels (interpret-mode on CPU) — pinned by the engine's
+    attention-selection log — and produce the reference path's exact
+    greedy tokens on the same mesh."""
+    import logging
+
+    from llmapigateway_tpu.parallel.mesh import MeshSpec, build_mesh
+    from tests.conftest import cpu_devices
+
+    devs = cpu_devices()[:4]
+    mesh_cfg = {"data": 2, "model": 2}    # KV=2 % 2 == 0 → manual axes
+
+    async def run(attention):
+        caplog.clear()
+        with caplog.at_level(logging.INFO,
+                             logger="llmapigateway_tpu.engine.engine"):
+            cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+                                    max_seq_len=128, prefill_chunk=32,
+                                    dtype="float32", decode_burst=2,
+                                    attention=attention, mesh=mesh_cfg)
+            eng = InferenceEngine(cfg, devices=devs)
+        logs = " ".join(r.message for r in caplog.records)
+        try:
+            req = await _generate(eng, "sharded pallas parity", max_tokens=6)
+        finally:
+            await eng.stop()
+        return req, logs
+
+    got, logs = await run("pallas")
+    assert "shard_map" in logs, logs      # the sharded kernel path engaged
+    ref, _ = await run("reference")
+    assert got.generated == ref.generated
+    assert got.finish_reason == ref.finish_reason
+
+
 async def test_pipelined_slot_reuse_no_token_bleed():
     """A slot released and re-admitted while a burst is in flight must not
     leak the dead request's tokens into the new one (epoch guard in
