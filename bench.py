@@ -121,7 +121,8 @@ def _other_python_procs() -> list[str]:
 
 
 def build_engine(args, kv_layout: str, preset: str | None = None,
-                 batch: int | None = None, quant: str = ""):
+                 batch: int | None = None, quant: str = "",
+                 kv_quant: str = ""):
     import logging
     # The engine logs its init phase breakdown (params-ready seconds etc.)
     # at INFO — surface it so a slow cold start is attributable from the
@@ -140,6 +141,7 @@ def build_engine(args, kv_layout: str, preset: str | None = None,
         preset=preset or args.preset, dtype="bfloat16",
         max_batch_size=batch or args.batch, max_seq_len=args.seq,
         prefill_chunk=min(512, args.prompt_len), quant=quant,
+        kv_quant=kv_quant,
         decode_burst=args.burst, kv_layout=kv_layout,
         # Paged: page 256 = the dense path's measured-optimal DMA block
         # (tools/profile_decode sweep) — the paged kernel's block IS the
@@ -255,8 +257,10 @@ def fill_and_time_decode(engine, args, steps: int | None = None) -> dict:
     n_params, param_bytes = _model_footprint(engine)
     step_s = decode_s / steps
     avg_live = args.prompt_len + warmup_steps + steps / 2
+    # bf16 K/V = 2 B/elem; int8 KV = 1 B/elem + fp32 scale per head_dim.
+    kv_elem_bytes = (1 + 4 / c.head_dim) if engine.kv_quant else 2
     kv_bytes = (2 * c.n_layers * B * c.n_kv_heads * avg_live * c.head_dim
-                * 2)                          # k+v, bf16
+                * kv_elem_bytes)              # k+v
     mfu = 2.0 * n_params * B / step_s / (args.peak_tflops * 1e12)
     hbm_gbps = (param_bytes + kv_bytes) / step_s / 1e9
     return {
@@ -608,6 +612,26 @@ def main() -> None:
         except Exception as e:
             errors.append(f"quant: {e!r}")
             note(f"FAILED quant phase: {e!r}")
+
+    # -- phase 4e: fully-quantized rung (int8 weights + int8 KV cache) -------
+    if args.quant_rung and not over_budget("quant_int8_kv8"):
+        try:
+            engine, init_s = build_engine(args, "contiguous", quant="int8",
+                                          kv_quant="int8")
+            r = fill_and_time_decode(engine, args)
+            extra["quant_int8_kv8"] = {
+                "tok_s": r["tok_s"],
+                "ms_per_decode_step": r["ms_per_decode_step"],
+                "mfu": r["mfu"], "hbm_gbps": r["hbm_gbps"],
+                "init_s": init_s,
+                "speedup_vs_bf16": (round(r["tok_s"] / contig_bf16_tok_s, 2)
+                                    if contig_bf16_tok_s else None),
+            }
+            note(f"quant int8+kv8: {r['tok_s']} tok/s")
+            del engine
+        except Exception as e:
+            errors.append(f"quant_kv: {e!r}")
+            note(f"FAILED quant_kv phase: {e!r}")
 
     # -- phase 4c: speculative decoding rung ---------------------------------
     if args.spec_draft and not over_budget("speculative"):

@@ -63,6 +63,12 @@ class LocalEngineConfig(BaseModel):
     # bytes each decode step streams from HBM — the decode roofline —
     # at a small accuracy cost (standard W8A8). Llama-family only (v1).
     quant: str = ""                 # "" | "int8"
+    # KV-cache quantization: "int8" stores K/V as symmetric per-token
+    # per-head int8 (+ fp32 scales, ~6% overhead) — halves KV bandwidth
+    # AND capacity footprint, the long-context/high-concurrency lever.
+    # v1: contiguous layout only (composes with `quant`; paged/seq/pipe
+    # are rejected at engine build).
+    kv_quant: str = ""              # "" | "int8"
     attention: str = "auto"         # "auto" | "pallas" | "reference"
     # Attention pattern for a seq-sharded mesh: "ring" rotates KV blocks over
     # ICI (works for any head count); "ulysses" all-to-alls heads<->sequence

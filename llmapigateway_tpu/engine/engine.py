@@ -251,6 +251,27 @@ class InferenceEngine:
         if self.quant and model_cfg.is_moe:
             raise ValueError("quant='int8' supports the llama family only "
                              "(MoE expert matmuls are not quantized in v1)")
+        # KV-cache quantization (int8 K/V + per-token scales).
+        self.kv_quant = engine_cfg.kv_quant
+        if self.kv_quant not in ("", "int8"):
+            raise ValueError(f"unknown kv_quant {self.kv_quant!r}; "
+                             f"expected '' | 'int8'")
+        if self.kv_quant:
+            if self.paged:
+                raise ValueError("kv_quant='int8' requires "
+                                 "kv_layout=contiguous (the paged pool is "
+                                 "not quantized in v1)")
+            if self.seq_n > 1 or self.pipe_n > 1:
+                raise ValueError("kv_quant='int8' does not compose with "
+                                 "seq/pipe sharding (v1: the ring/staged "
+                                 "attention paths read plain cache blocks)")
+            if engine_cfg.spec_draft_len:
+                raise ValueError(
+                    "kv_quant='int8' does not compose with speculative "
+                    "decoding: the verify forward sees draft tokens at "
+                    "full precision (self-block) while plain decode reads "
+                    "them quantized from the cache, so the output would "
+                    "no longer be exactly the greedy sequence")
 
         # Prompt-lookup speculative decoding (engine/speculative.py).
         self.spec_k = max(0, engine_cfg.spec_draft_len)
@@ -377,9 +398,18 @@ class InferenceEngine:
                 max_seq=self.S if self.seq_n > 1 else None,
                 n_layers=c.n_layers if self.pipe_n > 1 else None)
             shape = (c.n_layers, self.B, c.n_kv_heads, self.S, c.head_dim)
-            self.cache = llama.KVCache(
-                k=zeros_global(shape, self.dtype, csh),
-                v=zeros_global(shape, self.dtype, csh))
+            if self.kv_quant == "int8":
+                # int8 values + per-token fp32 scales (same sharding minus
+                # the head_dim axis).
+                ssh = NamedSharding(self.mesh, P(*csh.spec[:-1]))
+                def qz():
+                    return {"q": zeros_global(shape, jnp.int8, csh),
+                            "s": zeros_global(shape[:-1], jnp.float32, ssh)}
+                self.cache = llama.KVCache(k=qz(), v=qz())
+            else:
+                self.cache = llama.KVCache(
+                    k=zeros_global(shape, self.dtype, csh),
+                    v=zeros_global(shape, self.dtype, csh))
         # Host-authoritative per-slot state, mirrored to device each step.
         self.lengths = np.zeros((self.B,), np.int32)
         self.active = np.zeros((self.B,), bool)
@@ -473,17 +503,22 @@ class InferenceEngine:
             global op every process of a multi-host deployment must join;
             followers run the same program with dummy sampling inputs and
             ignore the token."""
-            # Slice this slot's cache rows: [L, 1, KV, S, Dh].
-            k_row = jax.lax.dynamic_slice_in_dim(cache.k, slot, 1, axis=1)
-            v_row = jax.lax.dynamic_slice_in_dim(cache.v, slot, 1, axis=1)
-            row_cache = llama.KVCache(k=k_row, v=v_row)
+            # Slice this slot's cache rows: [L, 1, KV, S, Dh]. tree.map
+            # covers the int8 {"q","s"} cache leaves uniformly.
+            def row_of(side):
+                return jax.tree.map(
+                    lambda a: jax.lax.dynamic_slice_in_dim(a, slot, 1,
+                                                           axis=1), side)
+            row_cache = llama.KVCache(k=row_of(cache.k), v=row_of(cache.v))
             lengths = start_len[None]
             logits, row_cache = prefill_forward(
                 params, c, tokens, lengths, row_cache)
-            new_k = jax.lax.dynamic_update_slice_in_dim(
-                cache.k, row_cache.k, slot, axis=1)
-            new_v = jax.lax.dynamic_update_slice_in_dim(
-                cache.v, row_cache.v, slot, axis=1)
+            new_k = jax.tree.map(
+                lambda full, row: jax.lax.dynamic_update_slice_in_dim(
+                    full, row, slot, axis=1), cache.k, row_cache.k)
+            new_v = jax.tree.map(
+                lambda full, row: jax.lax.dynamic_update_slice_in_dim(
+                    full, row, slot, axis=1), cache.v, row_cache.v)
             row = jax.lax.with_sharding_constraint(
                 jax.lax.dynamic_index_in_dim(logits[0], last_idx, 0,
                                              keepdims=False), replicated)
@@ -700,6 +735,14 @@ class InferenceEngine:
         impl = self._resolve_attention_impl()
         if impl == "pallas":
             if self.mesh.size > 1:
+                if self.kv_quant:
+                    # The shard_map wrapper's prefix specs assume plain
+                    # 4-D cache leaves; the {"q","s"} scale leaf is 3-D.
+                    # The jnp path partitions fine under GSPMD (v1).
+                    logger.warning(
+                        "attention: kv_quant + multi-chip pallas not "
+                        "supported (v1) — using the reference path")
+                    return None
                 # Sharded cache → the kernels must run under shard_map
                 # (pallas_call has no GSPMD partitioning rule).
                 from ..ops import make_sharded_cache_attention_fn

@@ -156,28 +156,50 @@ class KVCache(NamedTuple):
     path unit-stride reads per head too. ``lengths`` ([B], int32) — tokens
     already cached per slot — lives in the engine's batch state, not here,
     so the cache stays a plain pytree of arrays.
+
+    With KV quantization (``kv_quant: "int8"``) each of k/v is instead the
+    sub-dict ``{"q": int8 [L,B,KV,S,Dh], "s": f32 [L,B,KV,S]}`` — symmetric
+    per-token-per-head scales, the same plain-or-quantized dict convention
+    as weight quant (models/quant.py). Ordinary pytree leaves: the layer
+    scan, GSPMD shardings, and row slicing all treat them uniformly.
     """
-    k: jax.Array
-    v: jax.Array
+    k: Any
+    v: Any
 
     @classmethod
     def create(cls, config: ModelConfig, batch: int, max_seq: int,
-               dtype=jnp.bfloat16) -> "KVCache":
+               dtype=jnp.bfloat16, kv_quant: str = "") -> "KVCache":
         shape = (config.n_layers, batch, config.n_kv_heads, max_seq,
                  config.head_dim)
+        if kv_quant == "int8":
+            def qz():
+                return {"q": jnp.zeros(shape, jnp.int8),
+                        "s": jnp.zeros(shape[:-1], jnp.float32)}
+            return cls(k=qz(), v=qz())
         return cls(k=jnp.zeros(shape, dtype=dtype),
                    v=jnp.zeros(shape, dtype=dtype))
 
 
-def insert_kv(layer_k: jax.Array, layer_v: jax.Array, k_new: jax.Array,
+def quantize_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric per-token-per-head int8 over the LAST dim (Dh).
+    x [..., Dh] → (int8 same shape, f32 scale [...])."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    s = jnp.maximum(amax, 1e-30) / 127.0
+    q = jnp.clip(jnp.round(xf / s[..., None]), -127, 127).astype(jnp.int8)
+    return q, s
+
+
+def insert_kv(layer_k, layer_v, k_new: jax.Array,
               v_new: jax.Array, lengths: jax.Array,
-              active: jax.Array | None) -> tuple[jax.Array, jax.Array]:
+              active: jax.Array | None):
     """Insert new tokens at [lengths, lengths+T) per row of the head-major
-    cache ([B, KV, S, Dh]). T is static; offsets are data — per-row
-    dynamic_update_slice through vmap (XLA lowers this efficiently on TPU).
-    Rows with ``active=False`` are left untouched: their cache is owned by
-    the prefill path. The ONE copy of this layout-sensitive invariant —
-    both the jnp and the Pallas attention paths go through it.
+    cache ([B, KV, S, Dh]; or its int8 ``{"q","s"}`` dict). T is static;
+    offsets are data — per-row dynamic_update_slice through vmap (XLA
+    lowers this efficiently on TPU). Rows with ``active=False`` are left
+    untouched: their cache is owned by the prefill path. The ONE copy of
+    this layout-sensitive invariant — both the jnp and the Pallas
+    attention paths go through it.
     """
     # Inactive rows: instead of a full-cache `where` (which copies every
     # byte of the cache each step), route their write to the row TAIL via
@@ -185,8 +207,9 @@ def insert_kv(layer_k: jax.Array, layer_v: jax.Array, k_new: jax.Array,
     # positions are never visible before being rewritten: position p is only
     # attended once some step has length >= p, and that step (prefill chunk
     # or decode insert at offset p) writes p first.
+    quant = isinstance(layer_k, dict)
+    S = (layer_k["q"] if quant else layer_k).shape[2]
     if active is not None:
-        S = layer_k.shape[2]
         lengths = jnp.where(active, lengths, S)
 
     def insert(cache_row, new_row, offset):
@@ -194,26 +217,44 @@ def insert_kv(layer_k: jax.Array, layer_v: jax.Array, k_new: jax.Array,
         return jax.lax.dynamic_update_slice(
             cache_row, new_row.transpose(1, 0, 2).astype(cache_row.dtype),
             (0, offset, 0))
+
+    def insert_s(scale_row, new_row, offset):
+        # scale_row [KV, S]; new_row [T, KV] → [KV, T]
+        return jax.lax.dynamic_update_slice(
+            scale_row, new_row.T.astype(scale_row.dtype), (0, offset))
+
+    if quant:
+        kq, ks = quantize_kv(k_new)                  # [B,T,KV,Dh], [B,T,KV]
+        vq, vs = quantize_kv(v_new)
+        return (
+            {"q": jax.vmap(insert)(layer_k["q"], kq, lengths),
+             "s": jax.vmap(insert_s)(layer_k["s"], ks, lengths)},
+            {"q": jax.vmap(insert)(layer_v["q"], vq, lengths),
+             "s": jax.vmap(insert_s)(layer_v["s"], vs, lengths)},
+        )
     inserted_k = jax.vmap(insert)(layer_k, k_new, lengths)
     inserted_v = jax.vmap(insert)(layer_v, v_new, lengths)
     return inserted_k, inserted_v
 
 
-def insert_kv_stacked(cache_k: jax.Array, cache_v: jax.Array,
+def insert_kv_stacked(cache_k, cache_v,
                       k_news: jax.Array, v_news: jax.Array,
                       lengths: jax.Array,
-                      active: jax.Array | None) -> tuple[jax.Array, jax.Array]:
+                      active: jax.Array | None):
     """Insert every layer's new tokens into the FULL stacked cache with one
     scatter — the deferred-decode half of :func:`insert_kv`.
 
-    cache_k/v: [L, B, KV, S, Dh]; k_news/v_news: [L, B, T, KV, Dh] (the
-    layer scan's stacked ys); lengths: [B]. One vmap(dynamic_update_slice)
-    over B for ALL layers costs ~40× less than a per-layer insert inside
-    the scan: the per-layer form lowers to 2·L serialized TPU scatters per
-    step (~2 ms/step at L=22), the stacked form to one (~0.1 ms) —
-    measured in tools/profile_insert.py. Inactive rows reuse insert_kv's
-    clamp-to-tail trick (see there for the visibility argument)."""
-    S = cache_k.shape[3]
+    cache_k/v: [L, B, KV, S, Dh] (or the int8 ``{"q","s"}`` dict);
+    k_news/v_news: [L, B, T, KV, Dh] (the layer scan's stacked ys, always
+    bf16/fp32 — quantization happens here at write time); lengths: [B].
+    One vmap(dynamic_update_slice) over B for ALL layers costs ~40× less
+    than a per-layer insert inside the scan: the per-layer form lowers to
+    2·L serialized TPU scatters per step (~2 ms/step at L=22), the stacked
+    form to one (~0.1 ms) — measured in tools/profile_insert.py. Inactive
+    rows reuse insert_kv's clamp-to-tail trick (see there for the
+    visibility argument)."""
+    quant = isinstance(cache_k, dict)
+    S = (cache_k["q"] if quant else cache_k).shape[3]
     if active is not None:
         lengths = jnp.where(active, lengths, S)
 
@@ -221,6 +262,22 @@ def insert_kv_stacked(cache_k: jax.Array, cache_v: jax.Array,
         # ck [L, KV, S, Dh]; new [L, T, KV, Dh] → [L, KV, T, Dh]
         return jax.lax.dynamic_update_slice(
             ck, new.transpose(0, 2, 1, 3).astype(ck.dtype), (0, 0, off, 0))
+
+    def ins_s(cs, new, off):
+        # cs [L, KV, S]; new [L, T, KV] → [L, KV, T]
+        return jax.lax.dynamic_update_slice(
+            cs, new.transpose(0, 2, 1).astype(cs.dtype), (0, 0, off))
+
+    if quant:
+        kq, ks = quantize_kv(k_news)          # [L,B,T,KV,Dh], [L,B,T,KV]
+        vq, vs = quantize_kv(v_news)
+        vb = partial(jax.vmap, in_axes=(1, 1, 0), out_axes=1)
+        return (
+            {"q": vb(ins)(cache_k["q"], kq, lengths),
+             "s": vb(ins_s)(cache_k["s"], ks, lengths)},
+            {"q": vb(ins)(cache_v["q"], vq, lengths),
+             "s": vb(ins_s)(cache_v["s"], vs, lengths)},
+        )
     new_k = jax.vmap(ins, in_axes=(1, 1, 0), out_axes=1)(
         cache_k, k_news, lengths)
     new_v = jax.vmap(ins, in_axes=(1, 1, 0), out_axes=1)(
@@ -241,20 +298,24 @@ def dense_decode_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     softmax is computed explicitly (no [S+1] concat) so every S-reduction
     stays a clean sharded reduction under GSPMD for seq-sharded caches.
 
-    q [B,1,H,Dh]; k_new/v_new [B,1,KV,Dh]; layer_k/v [B,KV,S,Dh] (stale).
+    q [B,1,H,Dh]; k_new/v_new [B,1,KV,Dh]; layer_k/v [B,KV,S,Dh] (stale;
+    or the int8 ``{"q","s"}`` dict — scales fold into scores/probs).
     Returns out [B, 1, H*Dh]; writes nothing.
     """
     B, T, H, Dh = q.shape
     KV = k_new.shape[2]
-    S = layer_k.shape[2]
+    lk, ks, lv, vs = _kv_dequant_views(layer_k, layer_v, q.dtype)
+    S = lk.shape[2]
     G = H // KV
     scale = Dh ** -0.5
 
     qg = q[:, 0].reshape(B, KV, G, Dh)
     kn = k_new[:, 0]                                    # [B, KV, Dh]
     vn = v_new[:, 0].astype(jnp.float32)
-    scores = jnp.einsum("bkgd,bksd->bkgs", qg, layer_k,
+    scores = jnp.einsum("bkgd,bksd->bkgs", qg, lk,
                         preferred_element_type=jnp.float32) * scale
+    if ks is not None:
+        scores = scores * ks[:, :, None, :]
     self_s = jnp.einsum("bkgd,bkd->bkg", qg, kn,
                         preferred_element_type=jnp.float32) * scale
 
@@ -267,10 +328,23 @@ def dense_decode_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     p = jnp.exp(scores - m[..., None])                             # [B,KV,G,S]
     p_self = jnp.exp(self_s - m)                                   # [B,KV,G]
     l = jnp.sum(p, axis=-1) + p_self
-    out = jnp.einsum("bkgs,bksd->bkgd", p.astype(layer_v.dtype), layer_v,
+    if vs is not None:
+        p = p * vs[:, :, None, :]
+    out = jnp.einsum("bkgs,bksd->bkgd", p.astype(lv.dtype), lv,
                      preferred_element_type=jnp.float32)
     out = (out + p_self[..., None] * vn[:, :, None, :]) / l[..., None]
     return out.reshape(B, 1, H * Dh).astype(q.dtype)
+
+
+def _kv_dequant_views(layer_k, layer_v, dtype):
+    """(k, ks, v, vs) from a plain or int8-quantized cache layer. The
+    per-token scale factors OUT of the Dh contraction — scores multiply by
+    ``ks`` after the QK dot, probs by ``vs`` before the PV dot — so no
+    dequantized [S, Dh] copy ever materializes."""
+    if isinstance(layer_k, dict):
+        return (layer_k["q"].astype(dtype), layer_k["s"],
+                layer_v["q"].astype(dtype), layer_v["s"])
+    return layer_k, None, layer_v, None
 
 
 def dense_verify_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
@@ -292,15 +366,18 @@ def dense_verify_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     """
     B, T, H, Dh = q.shape
     KV = k_new.shape[2]
-    S = layer_k.shape[2]
+    lk, ks, lv, vs = _kv_dequant_views(layer_k, layer_v, q.dtype)
+    S = lk.shape[2]
     G = H // KV
     scale = Dh ** -0.5
 
     qg = q.reshape(B, T, KV, G, Dh).transpose(0, 2, 3, 1, 4)  # [B,KV,G,T,Dh]
     kn = k_new.transpose(0, 2, 1, 3)                          # [B,KV,T,Dh]
     vn = v_new.transpose(0, 2, 1, 3).astype(jnp.float32)
-    scores = jnp.einsum("bkgtd,bksd->bkgts", qg, layer_k,
+    scores = jnp.einsum("bkgtd,bksd->bkgts", qg, lk,
                         preferred_element_type=jnp.float32) * scale
+    if ks is not None:
+        scores = scores * ks[:, :, None, None, :]
     self_s = jnp.einsum("bkgtd,bkud->bkgtu", qg, kn,
                         preferred_element_type=jnp.float32) * scale
 
@@ -317,7 +394,9 @@ def dense_verify_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     p = jnp.exp(scores - m[..., None])                      # [B,KV,G,T,S]
     p_self = jnp.exp(self_s - m[..., None])                 # [B,KV,G,T,T]
     l = jnp.sum(p, axis=-1) + jnp.sum(p_self, axis=-1)
-    out = jnp.einsum("bkgts,bksd->bkgtd", p.astype(layer_v.dtype), layer_v,
+    if vs is not None:
+        p = p * vs[:, :, None, None, :]
+    out = jnp.einsum("bkgts,bksd->bkgtd", p.astype(lv.dtype), lv,
                      preferred_element_type=jnp.float32)
     out = out + jnp.einsum("bkgtu,bkud->bkgtd", p_self, vn)
     out = out / l[..., None]
@@ -342,10 +421,11 @@ def dense_cache_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     """
     B, T, H, Dh = q.shape
     KV = k_new.shape[2]
-    S = layer_k.shape[2]
 
     layer_k, layer_v = insert_kv(layer_k, layer_v, k_new, v_new,
                                  lengths, active)
+    lk, ks, lv, vs = _kv_dequant_views(layer_k, layer_v, q.dtype)
+    S = lk.shape[2]
 
     # GQA WITHOUT materializing repeated KV: group the query heads
     # [B,T,H,Dh] → [B,KV,G,T,Dh] and contract each group against its single
@@ -353,9 +433,11 @@ def dense_cache_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     # — no fp32 copy of the cache, no 8× `repeat` traffic.
     group = H // KV
     qg = q.reshape(B, T, KV, group, Dh).transpose(0, 2, 3, 1, 4)
-    scores = jnp.einsum("bkgtd,bksd->bkgts", qg, layer_k,
+    scores = jnp.einsum("bkgtd,bksd->bkgts", qg, lk,
                         preferred_element_type=jnp.float32)
     scores = scores / jnp.sqrt(jnp.asarray(Dh, jnp.float32))
+    if ks is not None:
+        scores = scores * ks[:, :, None, None, :]
 
     # Mask: key position s is visible to query t iff s <= lengths + t.
     q_pos = lengths[:, None] + jnp.arange(T)[None, :]          # [B, T]
@@ -366,8 +448,10 @@ def dense_cache_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     scores = jnp.where(visible[:, None, None, :, :], scores, -1e30)
 
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgts,bksd->bkgtd", probs.astype(layer_v.dtype),
-                     layer_v, preferred_element_type=jnp.float32)
+    if vs is not None:
+        probs = probs * vs[:, :, None, None, :]
+    out = jnp.einsum("bkgts,bksd->bkgtd", probs.astype(lv.dtype),
+                     lv, preferred_element_type=jnp.float32)
     # [B,KV,G,T,Dh] → [B,T,H*Dh]
     out = out.transpose(0, 3, 1, 2, 4).reshape(B, T, H * Dh)
     return out.astype(q.dtype), layer_k, layer_v

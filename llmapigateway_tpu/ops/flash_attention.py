@@ -74,8 +74,47 @@ def self_column_init(q_ref, kn_ref, vn_ref, m_ref, l_ref, acc_ref) -> None:
     acc_ref[:] = jnp.broadcast_to(vn, acc_ref.shape)
 
 
-def _decode_kernel(nvalid_ref, q_ref, kn_ref, vn_ref, k_ref, v_ref, o_ref,
-                   m_ref, l_ref, acc_ref, *, block_s: int):
+def attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask,
+                 ks_ref=None, vs_ref=None) -> None:
+    """One online-softmax block update — THE shared compute of every flash
+    kernel here and in ops/paged_attention.py (dense/paged × decode/prefill
+    × bf16/int8-KV). ``mask(scores)`` applies the caller's visibility rule;
+    ``ks_ref``/``vs_ref`` are the optional int8-KV per-token scale blocks
+    ``[1, BS]``: the scale factors out of the Dh contraction, so scores
+    multiply by ``ks`` after the QK dot and probs by ``vs`` before the PV
+    dot (after ``l`` accumulates — the softmax denominator is unscaled),
+    and no dequantized [BS, Dh] block is ever built."""
+    q = q_ref[0, 0].astype(jnp.float32)            # [rows, Dh]
+    k = k_ref[0, 0].astype(jnp.float32)            # [BS, Dh] (bf16 or int8)
+    v = v_ref[0, 0].astype(jnp.float32)
+    scores = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)        # [rows, BS]
+    scores *= q.shape[-1] ** -0.5
+    if ks_ref is not None:
+        scores = scores * ks_ref[0]
+    scores = mask(scores)
+
+    m_prev = m_ref[:, :1]
+    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    e = jnp.exp(scores - m_new)                    # [rows, BS]
+    l_ref[:, :1] = alpha * l_ref[:, :1] + jnp.sum(e, axis=1, keepdims=True)
+    p = e if vs_ref is None else e * vs_ref[0]
+    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+        p, v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)        # [rows, Dh]
+    m_ref[:, :1] = m_new
+
+
+def _decode_kernel(nvalid_ref, q_ref, kn_ref, vn_ref, *refs, block_s: int):
+    # refs: (k, v, o, m, l, acc) — or with int8 KV (k, ks, v, vs, o, m, l,
+    # acc); arity is static at trace time.
+    if len(refs) == 8:
+        k_ref, ks_ref, v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = refs
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     s = pl.program_id(2)
     n_sb = pl.num_programs(2)
@@ -88,27 +127,12 @@ def _decode_kernel(nvalid_ref, q_ref, kn_ref, vn_ref, k_ref, v_ref, o_ref,
 
     @pl.when(s * block_s < n_valid)
     def _block():
-        q = q_ref[0, 0].astype(jnp.float32)            # [G, Dh]
-        k = k_ref[0, 0].astype(jnp.float32)            # [BS, Dh]
-        v = v_ref[0, 0].astype(jnp.float32)            # [BS, Dh]
-        scores = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)        # [G, BS]
-        scores *= q.shape[-1] ** -0.5
-
-        s_global = s * block_s + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 1)
-        scores = jnp.where(s_global < n_valid, scores, NEG_INF)
-
-        m_prev = m_ref[:, :1]                          # [G, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)                # [G, 1]
-        p = jnp.exp(scores - m_new)                    # [G, BS]
-        l_ref[:, :1] = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)        # [G, Dh]
-        m_ref[:, :1] = m_new
+        def mask(scores):
+            s_global = s * block_s + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 1)
+            return jnp.where(s_global < n_valid, scores, NEG_INF)
+        attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask,
+                     ks_ref, vs_ref)
 
     @pl.when(s == n_sb - 1)
     def _out():
@@ -117,20 +141,24 @@ def _decode_kernel(nvalid_ref, q_ref, kn_ref, vn_ref, k_ref, v_ref, o_ref,
 
 
 def flash_decode_attention(q: jax.Array, k_new: jax.Array,
-                           v_new: jax.Array, layer_k: jax.Array,
-                           layer_v: jax.Array, n_stale: jax.Array,
+                           v_new: jax.Array, layer_k, layer_v,
+                           n_stale: jax.Array,
                            *, block_s: int = 128,
                            interpret: bool | None = None) -> jax.Array:
     """Ragged single-token attention over a STALE cache plus the new token.
 
     q: [B, H, Dh] (RoPE applied); k_new/v_new: [B, KV, Dh] — the current
     token's key/value (NOT yet in the cache; folded in as the online
-    softmax's initial state); layer_k/v: [B, KV, S, Dh] (head-major);
+    softmax's initial state); layer_k/v: [B, KV, S, Dh] (head-major), or
+    the int8 ``{"q","s"}`` dicts (models/llama.py kv_quant layout — the
+    kernel gains per-token scale blocks, see :func:`attend_block`);
     n_stale: [B] int32 — visible stale prefix per slot (the query's
     position; 0 for a fresh slot). Returns [B, H * Dh] in q.dtype.
     """
     B, H, Dh = q.shape
-    KV, S = layer_k.shape[1], layer_k.shape[2]
+    quant = isinstance(layer_k, dict)
+    kq = layer_k["q"] if quant else layer_k
+    KV, S = kq.shape[1], kq.shape[2]
     G = H // KV
     block_s = min(block_s, S)
     if S % block_s:
@@ -146,6 +174,19 @@ def flash_decode_attention(q: jax.Array, k_new: jax.Array,
         last = jnp.maximum((nv[b] + block_s - 1) // block_s - 1, 0)
         return b, h, jnp.minimum(s, last), 0
 
+    def scale_index(b, h, s, nv):
+        last = jnp.maximum((nv[b] + block_s - 1) // block_s - 1, 0)
+        return b, h, jnp.minimum(s, last)
+
+    kv_spec = pl.BlockSpec((1, 1, block_s, Dh), kv_index)
+    s_spec = pl.BlockSpec((1, 1, block_s), scale_index)
+    if quant:
+        kv_operands = (layer_k["q"], layer_k["s"], layer_v["q"], layer_v["s"])
+        kv_specs = [kv_spec, s_spec, kv_spec, s_spec]
+    else:
+        kv_operands = (layer_k, layer_v)
+        kv_specs = [kv_spec, kv_spec]
+
     out = pl.pallas_call(
         functools.partial(_decode_kernel, block_s=block_s),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -155,8 +196,7 @@ def flash_decode_attention(q: jax.Array, k_new: jax.Array,
                 pl.BlockSpec((1, 1, G, Dh), lambda b, h, s, nv: (b, h, 0, 0)),
                 pl.BlockSpec((1, 1, 1, Dh), lambda b, h, s, nv: (b, h, 0, 0)),
                 pl.BlockSpec((1, 1, 1, Dh), lambda b, h, s, nv: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, block_s, Dh), kv_index),
-                pl.BlockSpec((1, 1, block_s, Dh), kv_index),
+                *kv_specs,
             ],
             out_specs=pl.BlockSpec((1, 1, G, Dh),
                                    lambda b, h, s, nv: (b, h, 0, 0)),
@@ -169,16 +209,24 @@ def flash_decode_attention(q: jax.Array, k_new: jax.Array,
         out_shape=jax.ShapeDtypeStruct((B, KV, G, Dh), q.dtype),
         interpret=_interpret_default() if interpret is None else interpret,
     )(n_stale.astype(jnp.int32), qg, k_new[:, :, None, :],
-      v_new[:, :, None, :], layer_k, layer_v)
+      v_new[:, :, None, :], *kv_operands)
     return out.reshape(B, H * Dh)
+
+
 
 
 # ---------------------------------------------------------------------------
 # Prefill kernel: q [B, T, H, Dh] vs cache [B, KV, S, Dh], causal from start
 # ---------------------------------------------------------------------------
 
-def _prefill_kernel(start_ref, q_ref, k_ref, v_ref, o_ref,
-                    m_ref, l_ref, acc_ref, *, block_t: int, block_s: int):
+def _prefill_kernel(start_ref, q_ref, *refs, block_t: int, block_s: int):
+    # refs: (k, v, o, m, l, acc) — or with int8 KV (k, ks, v, vs, o, m, l,
+    # acc); arity is static at trace time.
+    if len(refs) == 8:
+        k_ref, ks_ref, v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = refs
+        ks_ref = vs_ref = None
     b = pl.program_id(0)
     t = pl.program_id(2)
     s = pl.program_id(3)
@@ -198,29 +246,14 @@ def _prefill_kernel(start_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(s * block_s <= last_q_pos)
     def _block():
-        q = q_ref[0, 0].astype(jnp.float32)            # [TB, Dh]
-        k = k_ref[0, 0].astype(jnp.float32)            # [BS, Dh]
-        v = v_ref[0, 0].astype(jnp.float32)            # [BS, Dh]
-        scores = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)        # [TB, BS]
-        scores *= q.shape[-1] ** -0.5
-
-        q_pos = start + t * block_t + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 0)
-        s_pos = s * block_s + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 1)
-        scores = jnp.where(s_pos <= q_pos, scores, NEG_INF)
-
-        m_prev = m_ref[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(scores - m_new)
-        l_ref[:, :1] = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[:, :1] = m_new
+        def mask(scores):
+            q_pos = start + t * block_t + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 0)
+            s_pos = s * block_s + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 1)
+            return jnp.where(s_pos <= q_pos, scores, NEG_INF)
+        attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask,
+                     ks_ref, vs_ref)
 
     @pl.when(s == n_sb - 1)
     def _out():
@@ -229,19 +262,22 @@ def _prefill_kernel(start_ref, q_ref, k_ref, v_ref, o_ref,
                        ).astype(o_ref.dtype)
 
 
-def flash_prefill_attention(q: jax.Array, layer_k: jax.Array,
-                            layer_v: jax.Array, start: jax.Array,
+def flash_prefill_attention(q: jax.Array, layer_k, layer_v,
+                            start: jax.Array,
                             *, block_t: int = 128, block_s: int = 128,
                             interpret: bool | None = None) -> jax.Array:
     """Causal chunk attention over an (already updated) cache.
 
     q: [B, T, H, Dh] — the chunk's queries at absolute positions
     ``start + t``; layer_k/v: [B, KV, S, Dh] (head-major) with the chunk's
-    keys already inserted at ``[start, start+T)``; start: [B] int32.
+    keys already inserted at ``[start, start+T)``, or the int8 ``{"q","s"}``
+    dicts (kv_quant layout); start: [B] int32.
     Returns [B, T, H * Dh] in q.dtype.
     """
     B, T, H, Dh = q.shape
-    KV, S = layer_k.shape[1], layer_k.shape[2]
+    quant = isinstance(layer_k, dict)
+    kq = layer_k["q"] if quant else layer_k
+    KV, S = kq.shape[1], kq.shape[2]
     G = H // KV
     block_t = min(block_t, T)
     block_s = min(block_s, S)
@@ -258,6 +294,19 @@ def flash_prefill_attention(q: jax.Array, layer_k: jax.Array,
         last_q_pos = st[b] + t * block_t + (block_t - 1)
         return b, h // G, jnp.minimum(s, last_q_pos // block_s), 0
 
+    def scale_index(b, h, t, s, st):
+        last_q_pos = st[b] + t * block_t + (block_t - 1)
+        return b, h // G, jnp.minimum(s, last_q_pos // block_s)
+
+    kv_spec = pl.BlockSpec((1, 1, block_s, Dh), kv_index)
+    s_spec = pl.BlockSpec((1, 1, block_s), scale_index)
+    if quant:
+        kv_operands = (layer_k["q"], layer_k["s"], layer_v["q"], layer_v["s"])
+        kv_specs = [kv_spec, s_spec, kv_spec, s_spec]
+    else:
+        kv_operands = (layer_k, layer_v)
+        kv_specs = [kv_spec, kv_spec]
+
     out = pl.pallas_call(
         functools.partial(_prefill_kernel, block_t=block_t, block_s=block_s),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -266,8 +315,7 @@ def flash_prefill_attention(q: jax.Array, layer_k: jax.Array,
             in_specs=[
                 pl.BlockSpec((1, 1, block_t, Dh),
                              lambda b, h, t, s, st: (b, h, t, 0)),
-                pl.BlockSpec((1, 1, block_s, Dh), kv_index),
-                pl.BlockSpec((1, 1, block_s, Dh), kv_index),
+                *kv_specs,
             ],
             out_specs=pl.BlockSpec((1, 1, block_t, Dh),
                                    lambda b, h, t, s, st: (b, h, t, 0)),
@@ -279,8 +327,10 @@ def flash_prefill_attention(q: jax.Array, layer_k: jax.Array,
         ),
         out_shape=jax.ShapeDtypeStruct((B, H, T, Dh), q.dtype),
         interpret=_interpret_default() if interpret is None else interpret,
-    )(start.astype(jnp.int32), qh, layer_k, layer_v)
+    )(start.astype(jnp.int32), qh, *kv_operands)
     return out.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
+
+
 
 
 # ---------------------------------------------------------------------------
@@ -309,7 +359,8 @@ def make_cache_attention_fn(block_s: int | None = None,
     ``block_s``/``block_t`` default to auto (largest pow2 divisor ≤128)."""
     def attention_fn(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
         B, T, H, Dh = q.shape
-        S = layer_k.shape[2]
+        quant = isinstance(layer_k, dict)
+        S = (layer_k["q"] if quant else layer_k).shape[2]
         from ..models.llama import insert_kv
         bs = block_s if block_s is not None else _auto_block(S, 128)
         layer_k, layer_v = insert_kv(layer_k, layer_v, k_new, v_new,
@@ -321,7 +372,8 @@ def make_cache_attention_fn(block_s: int | None = None,
         return out, layer_k, layer_v
 
     def decode(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
-        S = layer_k.shape[2]
+        quant = isinstance(layer_k, dict)
+        S = (layer_k["q"] if quant else layer_k).shape[2]
         # Decode blocks default wider than prefill (256 vs 128): the grid
         # is (B, KV, S/bs) programs whose per-program work is one small
         # matmul — at bs=128 the launch/DMA overhead of 256 tiny programs
@@ -330,8 +382,8 @@ def make_cache_attention_fn(block_s: int | None = None,
         bs = block_s if block_s is not None else _auto_block(S, 256)
         n_stale = lengths if active is None else jnp.where(active, lengths, 0)
         out = flash_decode_attention(
-            q[:, 0], k_new[:, 0], v_new[:, 0], layer_k, layer_v, n_stale,
-            block_s=bs, interpret=interpret)
+            q[:, 0], k_new[:, 0], v_new[:, 0], layer_k, layer_v,
+            n_stale, block_s=bs, interpret=interpret)
         return out[:, None, :]
 
     from ..models.llama import insert_kv_stacked

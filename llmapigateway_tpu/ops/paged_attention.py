@@ -41,7 +41,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..models.config import ModelConfig
-from .flash_attention import self_column_init
+from .flash_attention import attend_block, self_column_init
 
 NEG_INF = -1e30
 
@@ -162,26 +162,11 @@ def _paged_decode_kernel(pt_ref, nvalid_ref, q_ref, kn_ref, vn_ref,
 
     @pl.when(j * page < n_valid)
     def _block():
-        q = q_ref[0, 0].astype(jnp.float32)            # [G, Dh]
-        k = k_ref[0, 0].astype(jnp.float32)            # [page, Dh]
-        v = v_ref[0, 0].astype(jnp.float32)
-        scores = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)        # [G, page]
-        scores *= q.shape[-1] ** -0.5
-
-        pos = j * page + jax.lax.broadcasted_iota(jnp.int32, scores.shape, 1)
-        scores = jnp.where(pos < n_valid, scores, NEG_INF)
-
-        m_prev = m_ref[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(scores - m_new)
-        l_ref[:, :1] = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[:, :1] = m_new
+        def mask(scores):
+            pos = j * page + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 1)
+            return jnp.where(pos < n_valid, scores, NEG_INF)
+        attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask)
 
     @pl.when(j == n_pb - 1)
     def _out():
@@ -265,29 +250,13 @@ def _paged_prefill_kernel(pt_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
 
     @pl.when(j * page <= last_q_pos)
     def _block():
-        q = q_ref[0, 0].astype(jnp.float32)            # [TB, Dh]
-        k = k_ref[0, 0].astype(jnp.float32)            # [page, Dh]
-        v = v_ref[0, 0].astype(jnp.float32)
-        scores = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)        # [TB, page]
-        scores *= q.shape[-1] ** -0.5
-
-        q_pos = start + t * block_t + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 0)
-        s_pos = j * page + jax.lax.broadcasted_iota(
-            jnp.int32, scores.shape, 1)
-        scores = jnp.where(s_pos <= q_pos, scores, NEG_INF)
-
-        m_prev = m_ref[:, :1]
-        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(scores - m_new)
-        l_ref[:, :1] = alpha * l_ref[:, :1] + jnp.sum(p, axis=1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        m_ref[:, :1] = m_new
+        def mask(scores):
+            q_pos = start + t * block_t + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 0)
+            s_pos = j * page + jax.lax.broadcasted_iota(
+                jnp.int32, scores.shape, 1)
+            return jnp.where(s_pos <= q_pos, scores, NEG_INF)
+        attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask)
 
     @pl.when(j == n_pb - 1)
     def _out():
