@@ -26,10 +26,13 @@ zero-egress image; decode FLOPs/bandwidth are weight-value-independent):
   4. a mid-size preset rung (llama-3b-class) — MFU must rise with width,
   5. a batch-scaling rung (bs=32) — throughput headroom past the
      comparable bs=8 shape,
-  6. an int8 weight-quantization rung (same shape as the headline;
-     decode is weight-bandwidth-bound so int8 should land near 2×),
-  7. a speculative-decoding rung (repetitive-text regime),
-  8. an in-model pallas-vs-jnp attention A/B (whole greedy decode step,
+  6. int8 quantization rungs (same shape as the headline; weights-only
+     and weights+KV — decode is weight-bandwidth-bound so int8 weights
+     should land near 2×),
+  7. a long-context rung (bf16 vs int8 KV at ctx ~2k, where live KV
+     bytes rival weight bytes),
+  8. a speculative-decoding rung (repetitive-text regime),
+  9. an in-model pallas-vs-jnp attention A/B (whole greedy decode step,
      slope-timed so remote-tunnel dispatch latency cancels).
 
 ``vs_baseline`` is value / 2000 — the BASELINE.md north-star decode
@@ -483,6 +486,12 @@ def main() -> None:
     ap.add_argument("--scale-steps", type=int, default=64)
     ap.add_argument("--quant-rung", type=int, default=1,
                     help="int8 weight-quant decode rung (0 disables)")
+    ap.add_argument("--long-ctx", type=int, default=1,
+                    help="long-context bf16-vs-int8-KV rung (0 disables)")
+    ap.add_argument("--long-seq", type=int, default=4096)
+    ap.add_argument("--long-prompt", type=int, default=2048)
+    ap.add_argument("--long-batch", type=int, default=4)
+    ap.add_argument("--long-steps", type=int, default=64)
     ap.add_argument("--spec-draft", type=int, default=3,
                     help="speculative rung draft length (0 disables)")
     ap.add_argument("--spec-bursts", type=int, default=12)
@@ -632,6 +641,36 @@ def main() -> None:
         except Exception as e:
             errors.append(f"quant_kv: {e!r}")
             note(f"FAILED quant_kv phase: {e!r}")
+
+    # -- phase 4f: long-context rung (bf16 KV vs int8 KV) --------------------
+    # At ctx ~2k+ the live KV bytes rival the weight bytes, so this is the
+    # regime where kv_quant's bandwidth halving shows up as tok/s (at the
+    # headline's ctx≈330 the KV term is ~3% of traffic and invisible).
+    if args.long_ctx and not over_budget("long_ctx"):
+        try:
+            largs = argparse.Namespace(**vars(args))
+            largs.seq, largs.prompt_len, largs.batch = (
+                args.long_seq, args.long_prompt, args.long_batch)
+            lc = {}
+            for label, kvq in (("bf16", ""), ("kv8", "int8")):
+                engine, _ = build_engine(largs, "contiguous", kv_quant=kvq)
+                r = fill_and_time_decode(engine, largs,
+                                         steps=args.long_steps)
+                lc[label] = {"tok_s": r["tok_s"],
+                             "ms_per_decode_step": r["ms_per_decode_step"],
+                             "hbm_gbps": r["hbm_gbps"]}
+                del engine
+            lc["shape"] = (f"bs={args.long_batch} "
+                           f"ctx={args.long_prompt}+{args.long_steps}")
+            lc["kv8_speedup"] = round(
+                lc["kv8"]["tok_s"] / lc["bf16"]["tok_s"], 2)
+            extra["long_ctx"] = lc
+            note(f"long-ctx {lc['shape']}: bf16 {lc['bf16']['tok_s']} vs "
+                 f"kv8 {lc['kv8']['tok_s']} tok/s "
+                 f"({lc['kv8_speedup']}x)")
+        except Exception as e:
+            errors.append(f"long_ctx: {e!r}")
+            note(f"FAILED long-ctx phase: {e!r}")
 
     # -- phase 4c: speculative decoding rung ---------------------------------
     if args.spec_draft and not over_budget("speculative"):

@@ -1544,6 +1544,12 @@ class InferenceEngine:
             "max_seq_len": self.S,
             "kv_layout": self.cfg.kv_layout,
         }
+        # Precision config — operators correlating quality/throughput need
+        # to see what the engine is actually running.
+        if self.quant:
+            out["quant"] = self.quant
+        if self.kv_quant:
+            out["kv_quant"] = self.kv_quant
         if self.paged:
             out["free_pages"] = self.allocator.free_pages
             out["total_pages"] = self.allocator.num_pages - 1

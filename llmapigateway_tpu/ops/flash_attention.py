@@ -213,8 +213,6 @@ def flash_decode_attention(q: jax.Array, k_new: jax.Array,
     return out.reshape(B, H * Dh)
 
 
-
-
 # ---------------------------------------------------------------------------
 # Prefill kernel: q [B, T, H, Dh] vs cache [B, KV, S, Dh], causal from start
 # ---------------------------------------------------------------------------
@@ -329,8 +327,6 @@ def flash_prefill_attention(q: jax.Array, layer_k, layer_v,
         interpret=_interpret_default() if interpret is None else interpret,
     )(start.astype(jnp.int32), qh, *kv_operands)
     return out.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
-
-
 
 
 # ---------------------------------------------------------------------------

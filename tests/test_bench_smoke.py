@@ -18,7 +18,9 @@ def test_bench_emits_contract_json_line():
          "--warmup", "4", "--burst", "4", "--seq", "256",
          "--prompt-len", "16", "--preset", "tiny-test",
          "--second-preset", "tiny-test", "--second-steps", "4",
-         "--scale-batch", "4", "--scale-steps", "4"],
+         "--scale-batch", "4", "--scale-steps", "4",
+         "--long-seq", "128", "--long-prompt", "32", "--long-batch", "2",
+         "--long-steps", "4"],
         capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
     assert r.returncode == 0, r.stderr[-2000:]
     lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
@@ -33,6 +35,6 @@ def test_bench_emits_contract_json_line():
     for field in ("ms_per_decode_step", "prefill_tok_s", "mfu", "hbm_gbps",
                   "roofline_fraction", "paged_tok_s", "second_preset",
                   "batch_scale", "speculative", "quant_int8",
-                  "quant_int8_kv8"):
+                  "quant_int8_kv8", "long_ctx"):
         assert field in extra, (field, sorted(extra))
     assert "phase_errors" not in extra, extra["phase_errors"]

@@ -151,11 +151,15 @@ def test_pipelined_forward_with_quant(quant_setup):
                                rtol=1e-4, atol=1e-4)
 
 
-def test_engine_e2e_with_quant():
-    """Engine with quant="int8" serves a greedy request end to end."""
+@pytest.mark.parametrize("preset", ["tiny-test", "tiny-qwen-test",
+                                    "tiny-gemma-test"])
+def test_engine_e2e_with_quant(preset):
+    """Engine with quant="int8" serves a greedy request end to end, for
+    every non-MoE family (qwen2 exercises the bias path, gemma the
+    tied-embedding head staying bf16)."""
     from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
 
-    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+    cfg = LocalEngineConfig(preset=preset, max_batch_size=2,
                             max_seq_len=128, prefill_chunk=16,
                             decode_burst=4, quant="int8",
                             prewarm_sampler_variants=False,
@@ -163,6 +167,7 @@ def test_engine_e2e_with_quant():
     engine = InferenceEngine(cfg)
     # Weights really are int8 on device.
     assert engine.params["layers"]["wq"]["q"].dtype == jnp.int8
+    assert engine.stats()["quant"] == "int8"
 
     async def run():
         await engine.start()
