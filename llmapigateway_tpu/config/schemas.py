@@ -66,8 +66,9 @@ class LocalEngineConfig(BaseModel):
     # KV-cache quantization: "int8" stores K/V as symmetric per-token
     # per-head int8 (+ fp32 scales, ~6% overhead) — halves KV bandwidth
     # AND capacity footprint, the long-context/high-concurrency lever.
-    # v1: contiguous layout only (composes with `quant`; paged/seq/pipe
-    # are rejected at engine build).
+    # Works with both KV layouts (a paged int8 pool packs 2x the tokens)
+    # and composes with `quant`; seq/pipe sharding and speculation are
+    # rejected at engine build (v1).
     kv_quant: str = ""              # "" | "int8"
     attention: str = "auto"         # "auto" | "pallas" | "reference"
     # Attention pattern for a seq-sharded mesh: "ring" rotates KV blocks over

@@ -257,10 +257,6 @@ class InferenceEngine:
             raise ValueError(f"unknown kv_quant {self.kv_quant!r}; "
                              f"expected '' | 'int8'")
         if self.kv_quant:
-            if self.paged:
-                raise ValueError("kv_quant='int8' requires "
-                                 "kv_layout=contiguous (the paged pool is "
-                                 "not quantized in v1)")
             if self.seq_n > 1 or self.pipe_n > 1:
                 raise ValueError("kv_quant='int8' does not compose with "
                                  "seq/pipe sharding (v1: the ring/staged "
@@ -386,9 +382,15 @@ class InferenceEngine:
             self.allocator = PageAllocator(num_pages, page, self.B, self.S)
             psh = paged_cache_sharding(self.mesh, c.n_kv_heads)
             shape = (c.n_layers, num_pages, c.n_kv_heads, page, c.head_dim)
-            self.cache = PagedKVCache(
-                k=jax.device_put(jnp.zeros(shape, self.dtype), psh),
-                v=jax.device_put(jnp.zeros(shape, self.dtype), psh))
+            # Layout owned by PagedKVCache.create (the one copy of the
+            # int8 {q,s} scheme); 5-D value leaves shard via psh, the 4-D
+            # scale planes via the same spec minus the head_dim axis.
+            pool = PagedKVCache.create(c, num_pages, page, self.dtype,
+                                       kv_quant=self.kv_quant)
+            ssh = NamedSharding(self.mesh, P(*psh.spec[:-1]))
+            put = lambda a: jax.device_put(a, psh if a.ndim == 5 else ssh)
+            self.cache = PagedKVCache(k=jax.tree.map(put, pool.k),
+                                      v=jax.tree.map(put, pool.v))
             self._d_table = None
             self._table_dirty = True
         else:
@@ -611,6 +613,12 @@ class InferenceEngine:
         from ..ops.paged_attention import PagedKVCache, make_paged_attention_fn
 
         impl = self._resolve_attention_impl()
+        if self.kv_quant and impl == "pallas" and self.mesh.size > 1:
+            # Same v1 exclusion as the dense path: the shard_map wrapper's
+            # prefix specs assume plain pool leaves.
+            logger.warning("attention: kv_quant + multi-chip pallas not "
+                           "supported (v1) — using the reference path")
+            impl = "reference"
         mesh = self.mesh if self.mesh.size > 1 else None
         logger.info("paged KV cache: %d pages × %d tokens, attention=%s",
                     self.allocator.num_pages, self.allocator.page_size, impl)

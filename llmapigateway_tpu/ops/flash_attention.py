@@ -107,14 +107,20 @@ def attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask,
     m_ref[:, :1] = m_new
 
 
-def _decode_kernel(nvalid_ref, q_ref, kn_ref, vn_ref, *refs, block_s: int):
-    # refs: (k, v, o, m, l, acc) — or with int8 KV (k, ks, v, vs, o, m, l,
-    # acc); arity is static at trace time.
+def unpack_kv_refs(refs):
+    """(k, ks, v, vs, o, m, l, acc) from a kernel's trailing refs. Without
+    int8-KV the scale refs are absent (arity 6) and come back None — THE
+    one copy of this arity contract, shared by all four flash kernels
+    (dense/paged × decode/prefill)."""
     if len(refs) == 8:
-        k_ref, ks_ref, v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
-    else:
-        k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = refs
-        ks_ref = vs_ref = None
+        return refs
+    k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    return k_ref, None, v_ref, None, o_ref, m_ref, l_ref, acc_ref
+
+
+def _decode_kernel(nvalid_ref, q_ref, kn_ref, vn_ref, *refs, block_s: int):
+    k_ref, ks_ref, v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = \
+        unpack_kv_refs(refs)
     b = pl.program_id(0)
     s = pl.program_id(2)
     n_sb = pl.num_programs(2)
@@ -218,13 +224,8 @@ def flash_decode_attention(q: jax.Array, k_new: jax.Array,
 # ---------------------------------------------------------------------------
 
 def _prefill_kernel(start_ref, q_ref, *refs, block_t: int, block_s: int):
-    # refs: (k, v, o, m, l, acc) — or with int8 KV (k, ks, v, vs, o, m, l,
-    # acc); arity is static at trace time.
-    if len(refs) == 8:
-        k_ref, ks_ref, v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
-    else:
-        k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = refs
-        ks_ref = vs_ref = None
+    k_ref, ks_ref, v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = \
+        unpack_kv_refs(refs)
     b = pl.program_id(0)
     t = pl.program_id(2)
     s = pl.program_id(3)

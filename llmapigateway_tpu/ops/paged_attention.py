@@ -33,7 +33,7 @@ exactly like the dense cache.
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -41,7 +41,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..models.config import ModelConfig
-from .flash_attention import attend_block, self_column_init
+from .flash_attention import attend_block, self_column_init, unpack_kv_refs
 
 NEG_INF = -1e30
 
@@ -52,36 +52,46 @@ def _interpret_default() -> bool:
 
 class PagedKVCache(NamedTuple):
     """k, v: [L, P, KV, page, Dh] — global page pool per layer. Scans over
-    the leading layer dim in llama.forward exactly like the dense KVCache."""
-    k: jax.Array
-    v: jax.Array
+    the leading layer dim in llama.forward exactly like the dense KVCache.
+    With ``kv_quant="int8"`` each of k/v is the ``{"q": int8, "s": f32
+    [L, P, KV, page]}`` dict (per-token-per-head scales — models/llama.py
+    KVCache convention)."""
+    k: Any
+    v: Any
 
     @classmethod
     def create(cls, config: ModelConfig, num_pages: int, page_size: int,
-               dtype=jnp.bfloat16) -> "PagedKVCache":
+               dtype=jnp.bfloat16, kv_quant: str = "") -> "PagedKVCache":
         shape = (config.n_layers, num_pages, config.n_kv_heads, page_size,
                  config.head_dim)
+        if kv_quant == "int8":
+            def qz():
+                return {"q": jnp.zeros(shape, jnp.int8),
+                        "s": jnp.zeros(shape[:-1], jnp.float32)}
+            return cls(k=qz(), v=qz())
         return cls(k=jnp.zeros(shape, dtype=dtype),
                    v=jnp.zeros(shape, dtype=dtype))
 
     @property
     def page_size(self) -> int:
-        return self.k.shape[3]
+        k = self.k["q"] if isinstance(self.k, dict) else self.k
+        return k.shape[3]
 
 
-def paged_insert_kv(layer_k: jax.Array, layer_v: jax.Array,
+def paged_insert_kv(layer_k, layer_v,
                     k_new: jax.Array, v_new: jax.Array,
                     page_table: jax.Array, lengths: jax.Array,
-                    active: jax.Array | None
-                    ) -> tuple[jax.Array, jax.Array]:
+                    active: jax.Array | None):
     """Scatter new tokens into the page pool at logical positions
     ``[lengths, lengths+T)`` per slot.
 
-    layer_k/v: [P, KV, page, Dh]; k_new/v_new: [B, T, KV, Dh];
+    layer_k/v: [P, KV, page, Dh] (or the int8 ``{"q","s"}`` dict — new
+    tokens quantize at write time); k_new/v_new: [B, T, KV, Dh];
     page_table: [B, NP]; lengths: [B]. Inactive slots and positions past
     the table's reach land on trash page 0 (one scatter, no branches).
     """
-    P, KV, page, Dh = layer_k.shape
+    quant = isinstance(layer_k, dict)
+    P, KV, page, Dh = (layer_k["q"] if quant else layer_k).shape
     B, T = k_new.shape[:2]
     NP = page_table.shape[1]
 
@@ -96,33 +106,45 @@ def paged_insert_kv(layer_k: jax.Array, layer_v: jax.Array,
 
     flat_page = phys.reshape(-1)                                      # [B*T]
     flat_off = off.reshape(-1)
-    flat_k = k_new.reshape(B * T, KV, Dh).astype(layer_k.dtype)
-    flat_v = v_new.reshape(B * T, KV, Dh).astype(layer_v.dtype)
-    # [P, KV, page, Dh] scattered at (page, :, offset, :) per new token.
+
+    # [P, KV, page(, Dh)] scattered at (page, :, offset(, :)) per token.
     # In-bounds by construction (phys from the table or trash page 0;
     # off = pos % page) — the mode hint drops XLA's per-element clamping.
-    layer_k = layer_k.at[flat_page, :, flat_off].set(
-        flat_k, mode="promise_in_bounds")
-    layer_v = layer_v.at[flat_page, :, flat_off].set(
-        flat_v, mode="promise_in_bounds")
+    def scatter(pool, new):
+        return pool.at[flat_page, :, flat_off].set(
+            new.astype(pool.dtype), mode="promise_in_bounds")
+
+    if quant:
+        from ..models.llama import quantize_kv
+        kq, ks = quantize_kv(k_new)                  # [B,T,KV,Dh], [B,T,KV]
+        vq, vs = quantize_kv(v_new)
+        return (
+            {"q": scatter(layer_k["q"], kq.reshape(B * T, KV, Dh)),
+             "s": scatter(layer_k["s"], ks.reshape(B * T, KV))},
+            {"q": scatter(layer_v["q"], vq.reshape(B * T, KV, Dh)),
+             "s": scatter(layer_v["s"], vs.reshape(B * T, KV))},
+        )
+    layer_k = scatter(layer_k, k_new.reshape(B * T, KV, Dh))
+    layer_v = scatter(layer_v, v_new.reshape(B * T, KV, Dh))
     return layer_k, layer_v
 
 
-def paged_insert_all(pool_k: jax.Array, pool_v: jax.Array,
+def paged_insert_all(pool_k, pool_v,
                      k_news: jax.Array, v_news: jax.Array,
                      page_table: jax.Array, lengths: jax.Array,
-                     active: jax.Array | None
-                     ) -> tuple[jax.Array, jax.Array]:
+                     active: jax.Array | None):
     """Insert every layer's ONE new decode token into the page pool with a
     single scatter (the paged half of the deferred-insert protocol —
     models/llama.py ``insert_kv_stacked`` is the dense twin).
 
-    pool_k/v: [L, P, KV, page, Dh]; k_news/v_news: [L, B, 1, KV, Dh] (the
-    layer scan's stacked ys); lengths: [B] — the token's logical position.
-    Masked/overflow writes land on trash page 0 as usual.
+    pool_k/v: [L, P, KV, page, Dh] (or the int8 ``{"q","s"}`` dict);
+    k_news/v_news: [L, B, 1, KV, Dh] (the layer scan's stacked ys, always
+    bf16/fp32 — quantization happens here at write time); lengths: [B] —
+    the token's logical position. Masked/overflow writes land on trash
+    page 0 as usual.
     """
-    L, P, KV, page, Dh = pool_k.shape
-    B = k_news.shape[1]
+    quant = isinstance(pool_k, dict)
+    page = (pool_k["q"] if quant else pool_k).shape[3]
     NP = page_table.shape[1]
 
     logical = jnp.clip(lengths // page, 0, NP - 1)                 # [B]
@@ -133,14 +155,22 @@ def paged_insert_all(pool_k: jax.Array, pool_v: jax.Array,
     phys = jnp.where(ok, phys, 0)
     off = lengths % page
 
-    newk = k_news[:, :, 0].transpose(1, 0, 2, 3).astype(pool_k.dtype)
-    newv = v_news[:, :, 0].transpose(1, 0, 2, 3).astype(pool_v.dtype)
     # Advanced indices (phys, off) are separated by slices, so the indexed
-    # result is [B, L, KV, Dh] — newk/newv match that layout. In-bounds by
-    # construction (see paged_insert_kv).
-    pool_k = pool_k.at[:, phys, :, off].set(newk, mode="promise_in_bounds")
-    pool_v = pool_v.at[:, phys, :, off].set(newv, mode="promise_in_bounds")
-    return pool_k, pool_v
+    # result is [B, L, KV(, Dh)] — the [L, B, ...] new tokens transpose to
+    # match. In-bounds by construction (see paged_insert_kv).
+    def scatter(pool, news):
+        new = news[:, :, 0].swapaxes(0, 1).astype(pool.dtype)
+        return pool.at[:, phys, :, off].set(new, mode="promise_in_bounds")
+
+    if quant:
+        from ..models.llama import quantize_kv
+        kq, ks = quantize_kv(k_news)      # [L,B,1,KV,Dh], [L,B,1,KV]
+        vq, vs = quantize_kv(v_news)
+        return (
+            {"q": scatter(pool_k["q"], kq), "s": scatter(pool_k["s"], ks)},
+            {"q": scatter(pool_v["q"], vq), "s": scatter(pool_v["s"], vs)},
+        )
+    return (scatter(pool_k, k_news), scatter(pool_v, v_news))
 
 
 # ---------------------------------------------------------------------------
@@ -148,8 +178,9 @@ def paged_insert_all(pool_k: jax.Array, pool_v: jax.Array,
 # ---------------------------------------------------------------------------
 
 def _paged_decode_kernel(pt_ref, nvalid_ref, q_ref, kn_ref, vn_ref,
-                         k_ref, v_ref, o_ref,
-                         m_ref, l_ref, acc_ref, *, page: int):
+                         *refs, page: int):
+    k_ref, ks_ref, v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = \
+        unpack_kv_refs(refs)
     b = pl.program_id(0)
     j = pl.program_id(2)
     n_pb = pl.num_programs(2)
@@ -166,7 +197,8 @@ def _paged_decode_kernel(pt_ref, nvalid_ref, q_ref, kn_ref, vn_ref,
             pos = j * page + jax.lax.broadcasted_iota(
                 jnp.int32, scores.shape, 1)
             return jnp.where(pos < n_valid, scores, NEG_INF)
-        attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask)
+        attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask,
+                     ks_ref, vs_ref)
 
     @pl.when(j == n_pb - 1)
     def _out():
@@ -175,20 +207,22 @@ def _paged_decode_kernel(pt_ref, nvalid_ref, q_ref, kn_ref, vn_ref,
 
 
 def paged_decode_attention(q: jax.Array, k_new: jax.Array,
-                           v_new: jax.Array, k_pages: jax.Array,
-                           v_pages: jax.Array, page_table: jax.Array,
+                           v_new: jax.Array, k_pages, v_pages,
+                           page_table: jax.Array,
                            n_stale: jax.Array, *,
                            interpret: bool | None = None) -> jax.Array:
     """Ragged single-token attention over the STALE page pool plus the new
     token (self column folded into the online-softmax init).
 
     q: [B, H, Dh] (RoPE applied); k_new/v_new: [B, KV, Dh];
-    k_pages/v_pages: [P, KV, page, Dh]; page_table: [B, NP];
-    n_stale: [B] int32 (the query's position; 0 for a fresh slot).
-    Returns [B, H*Dh].
+    k_pages/v_pages: [P, KV, page, Dh] or the int8 ``{"q","s"}`` dicts;
+    page_table: [B, NP]; n_stale: [B] int32 (the query's position; 0 for a
+    fresh slot). Returns [B, H*Dh].
     """
     B, H, Dh = q.shape
-    KV, page = k_pages.shape[1], k_pages.shape[2]
+    quant = isinstance(k_pages, dict)
+    kq = k_pages["q"] if quant else k_pages
+    KV, page = kq.shape[1], kq.shape[2]
     NP = page_table.shape[1]
     G = H // KV
     qg = q.reshape(B, KV, G, Dh)
@@ -197,6 +231,20 @@ def paged_decode_attention(q: jax.Array, k_new: jax.Array,
     def kv_index(b, h, j, pt, nv):
         last = jnp.maximum((nv[b] + page - 1) // page - 1, 0)
         return pt[b, jnp.minimum(j, last)], h, 0, 0
+
+    def scale_index(b, h, j, pt, nv):
+        last = jnp.maximum((nv[b] + page - 1) // page - 1, 0)
+        return pt[b, jnp.minimum(j, last)], h, 0
+
+    kv_spec = pl.BlockSpec((1, 1, page, Dh), kv_index)
+    s_spec = pl.BlockSpec((1, 1, page), scale_index)
+    if quant:
+        kv_operands = (k_pages["q"], k_pages["s"],
+                       v_pages["q"], v_pages["s"])
+        kv_specs = [kv_spec, s_spec, kv_spec, s_spec]
+    else:
+        kv_operands = (k_pages, v_pages)
+        kv_specs = [kv_spec, kv_spec]
 
     out = pl.pallas_call(
         functools.partial(_paged_decode_kernel, page=page),
@@ -210,8 +258,7 @@ def paged_decode_attention(q: jax.Array, k_new: jax.Array,
                              lambda b, h, j, pt, nv: (b, h, 0, 0)),
                 pl.BlockSpec((1, 1, 1, Dh),
                              lambda b, h, j, pt, nv: (b, h, 0, 0)),
-                pl.BlockSpec((1, 1, page, Dh), kv_index),
-                pl.BlockSpec((1, 1, page, Dh), kv_index),
+                *kv_specs,
             ],
             out_specs=pl.BlockSpec((1, 1, G, Dh),
                                    lambda b, h, j, pt, nv: (b, h, 0, 0)),
@@ -224,7 +271,7 @@ def paged_decode_attention(q: jax.Array, k_new: jax.Array,
         out_shape=jax.ShapeDtypeStruct((B, KV, G, Dh), q.dtype),
         interpret=_interpret_default() if interpret is None else interpret,
     )(page_table.astype(jnp.int32), n_stale.astype(jnp.int32),
-      qg, k_new[:, :, None, :], v_new[:, :, None, :], k_pages, v_pages)
+      qg, k_new[:, :, None, :], v_new[:, :, None, :], *kv_operands)
     return out.reshape(B, H * Dh)
 
 
@@ -232,8 +279,10 @@ def paged_decode_attention(q: jax.Array, k_new: jax.Array,
 # Prefill kernel: q [B, T, H, Dh] vs pages, causal from per-slot start
 # ---------------------------------------------------------------------------
 
-def _paged_prefill_kernel(pt_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
-                          m_ref, l_ref, acc_ref, *, block_t: int, page: int):
+def _paged_prefill_kernel(pt_ref, start_ref, q_ref, *refs,
+                          block_t: int, page: int):
+    k_ref, ks_ref, v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = \
+        unpack_kv_refs(refs)
     b = pl.program_id(0)
     t = pl.program_id(2)
     j = pl.program_id(3)
@@ -256,7 +305,8 @@ def _paged_prefill_kernel(pt_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
             s_pos = j * page + jax.lax.broadcasted_iota(
                 jnp.int32, scores.shape, 1)
             return jnp.where(s_pos <= q_pos, scores, NEG_INF)
-        attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask)
+        attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask,
+                     ks_ref, vs_ref)
 
     @pl.when(j == n_pb - 1)
     def _out():
@@ -265,18 +315,20 @@ def _paged_prefill_kernel(pt_ref, start_ref, q_ref, k_ref, v_ref, o_ref,
                        ).astype(o_ref.dtype)
 
 
-def paged_prefill_attention(q: jax.Array, k_pages: jax.Array,
-                            v_pages: jax.Array, page_table: jax.Array,
+def paged_prefill_attention(q: jax.Array, k_pages, v_pages,
+                            page_table: jax.Array,
                             start: jax.Array, *, block_t: int = 128,
                             interpret: bool | None = None) -> jax.Array:
     """Causal chunk attention over the page pool (keys already inserted).
 
     q: [B, T, H, Dh] at absolute positions ``start + t``;
-    k_pages/v_pages: [P, KV, page, Dh]; page_table: [B, NP]; start: [B].
-    Returns [B, T, H*Dh].
+    k_pages/v_pages: [P, KV, page, Dh] or the int8 ``{"q","s"}`` dicts;
+    page_table: [B, NP]; start: [B]. Returns [B, T, H*Dh].
     """
     B, T, H, Dh = q.shape
-    KV, page = k_pages.shape[1], k_pages.shape[2]
+    quant = isinstance(k_pages, dict)
+    kq = k_pages["q"] if quant else k_pages
+    KV, page = kq.shape[1], kq.shape[2]
     NP = page_table.shape[1]
     G = H // KV
     block_t = min(block_t, T)
@@ -289,6 +341,20 @@ def paged_prefill_attention(q: jax.Array, k_pages: jax.Array,
         last_q_pos = st[b] + t * block_t + (block_t - 1)
         return pt[b, jnp.minimum(j, last_q_pos // page)], h // G, 0, 0
 
+    def scale_index(b, h, t, j, pt, st):
+        last_q_pos = st[b] + t * block_t + (block_t - 1)
+        return pt[b, jnp.minimum(j, last_q_pos // page)], h // G, 0
+
+    kv_spec = pl.BlockSpec((1, 1, page, Dh), kv_index)
+    s_spec = pl.BlockSpec((1, 1, page), scale_index)
+    if quant:
+        kv_operands = (k_pages["q"], k_pages["s"],
+                       v_pages["q"], v_pages["s"])
+        kv_specs = [kv_spec, s_spec, kv_spec, s_spec]
+    else:
+        kv_operands = (k_pages, v_pages)
+        kv_specs = [kv_spec, kv_spec]
+
     out = pl.pallas_call(
         functools.partial(_paged_prefill_kernel, block_t=block_t, page=page),
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -297,8 +363,7 @@ def paged_prefill_attention(q: jax.Array, k_pages: jax.Array,
             in_specs=[
                 pl.BlockSpec((1, 1, block_t, Dh),
                              lambda b, h, t, j, pt, st: (b, h, t, 0)),
-                pl.BlockSpec((1, 1, page, Dh), kv_index),
-                pl.BlockSpec((1, 1, page, Dh), kv_index),
+                *kv_specs,
             ],
             out_specs=pl.BlockSpec((1, 1, block_t, Dh),
                                    lambda b, h, t, j, pt, st: (b, h, t, 0)),
@@ -311,7 +376,7 @@ def paged_prefill_attention(q: jax.Array, k_pages: jax.Array,
         out_shape=jax.ShapeDtypeStruct((B, H, T, Dh), q.dtype),
         interpret=_interpret_default() if interpret is None else interpret,
     )(page_table.astype(jnp.int32), start.astype(jnp.int32),
-      qh, k_pages, v_pages)
+      qh, *kv_operands)
     return out.transpose(0, 2, 1, 3).reshape(B, T, H * Dh)
 
 
@@ -319,15 +384,20 @@ def paged_prefill_attention(q: jax.Array, k_pages: jax.Array,
 # Reference jnp path (CPU tests / non-TPU backends) + attention_fn adapter
 # ---------------------------------------------------------------------------
 
-def gather_pages(layer_pages: jax.Array, page_table: jax.Array,
-                 max_seq: int) -> jax.Array:
-    """Materialize [B, KV, S, Dh] from the pool — reference path only."""
-    P, KV, page, Dh = layer_pages.shape
+def gather_pages(layer_pages, page_table: jax.Array, max_seq: int):
+    """Materialize the dense [B, KV, S(, Dh)] view from the pool —
+    reference path only. Dict pools gather per leaf (the int8 values and
+    their scale plane share the page geometry)."""
+    if isinstance(layer_pages, dict):
+        return {k: gather_pages(v, page_table, max_seq)
+                for k, v in layer_pages.items()}
+    KV, page = layer_pages.shape[1], layer_pages.shape[2]
     NP = page_table.shape[1]
     n_pages = min(NP, (max_seq + page - 1) // page)
-    picked = layer_pages[page_table[:, :n_pages]]     # [B, n, KV, page, Dh]
-    seq = picked.transpose(0, 2, 1, 3, 4).reshape(
-        page_table.shape[0], KV, n_pages * page, Dh)
+    picked = layer_pages[page_table[:, :n_pages]]     # [B, n, KV, page(,Dh)]
+    picked = jnp.moveaxis(picked, 1, 2)               # [B, KV, n, page(,Dh)]
+    seq = picked.reshape(page_table.shape[0], KV, n_pages * page,
+                         *picked.shape[4:])
     return seq[:, :, :max_seq]
 
 
@@ -376,18 +446,29 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
 
     msize = mesh.shape.get("model", 1) if mesh is not None else 1
 
+    def _dequant_dense(d, dtype):
+        """Gathered dict → dense float view (reference path only; the
+        Pallas kernels consume the int8 pool + scales directly)."""
+        if isinstance(d, dict):
+            return d["q"].astype(dtype) * d["s"][..., None].astype(dtype)
+        return d
+
     def attention_fn(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
         B, T, H, Dh = q.shape
-        KV = layer_k.shape[1]
+        quant = isinstance(layer_k, dict)
+        KV = (layer_k["q"] if quant else layer_k).shape[1]
         layer_k, layer_v = paged_insert_kv(layer_k, layer_v, k_new, v_new,
                                            page_table, lengths, active)
         if impl == "reference":
-            dense_k = gather_pages(layer_k, page_table, max_seq)
-            dense_v = gather_pages(layer_v, page_table, max_seq)
+            dense_k = _dequant_dense(
+                gather_pages(layer_k, page_table, max_seq), q.dtype)
+            dense_v = _dequant_dense(
+                gather_pages(layer_v, page_table, max_seq), q.dtype)
             out = _paged_reference_core(q, dense_k, dense_v, lengths,
                                         active, T)
             return out, layer_k, layer_v
-        shard = msize > 1 and KV % msize == 0 and H % msize == 0
+        shard = (msize > 1 and KV % msize == 0 and H % msize == 0
+                 and not quant)
         pool = P(None, "model", None, None)
         bt = block_t if block_t is not None else min(T & (-T), 128)
         if shard:
@@ -409,15 +490,19 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
     def decode(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
         """Deferred-decode: stale pool + self column, no insert."""
         B, T, H, Dh = q.shape
-        KV = layer_k.shape[1]
+        quant = isinstance(layer_k, dict)
+        KV = (layer_k["q"] if quant else layer_k).shape[1]
         n_stale = lengths if active is None else jnp.where(active, lengths, 0)
         if impl == "reference":
+            # dense_decode_attention is dict-aware: the gathered int8
+            # view + scales pass through un-dequantized.
             from ..models.llama import dense_decode_attention
             dense_k = gather_pages(layer_k, page_table, max_seq)
             dense_v = gather_pages(layer_v, page_table, max_seq)
             return dense_decode_attention(q, k_new, v_new, dense_k, dense_v,
                                           n_stale, None)
-        shard = msize > 1 and KV % msize == 0 and H % msize == 0
+        shard = (msize > 1 and KV % msize == 0 and H % msize == 0
+                 and not quant)
         pool = P(None, "model", None, None)
         if shard:
             f = jax.shard_map(

@@ -110,20 +110,25 @@ def test_pallas_q8_kernels_match_jnp_reference(setup):
     np.testing.assert_allclose(got2, want2, rtol=2e-2, atol=2e-2)
 
 
-@pytest.mark.parametrize("quant", ["", "int8"])
-def test_engine_e2e_with_kv_quant(quant):
-    """Engine serves greedily with the int8 cache — alone and combined
-    with int8 weights (the fully-quantized configuration)."""
+@pytest.mark.parametrize("quant,kv_layout", [("", "contiguous"),
+                                             ("int8", "contiguous"),
+                                             ("", "paged")])
+def test_engine_e2e_with_kv_quant(quant, kv_layout):
+    """Engine serves greedily with the int8 cache — alone, combined with
+    int8 weights (the fully-quantized configuration), and on the paged
+    pool (the capacity combo: int8 pages pack 2x the tokens)."""
     from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
 
     cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
                             max_seq_len=128, prefill_chunk=16,
                             decode_burst=4, kv_quant="int8", quant=quant,
+                            kv_layout=kv_layout, kv_page_size=32,
                             prewarm_sampler_variants=False,
                             compilation_cache_dir="off")
     engine = InferenceEngine(cfg)
     assert engine.cache.k["q"].dtype == jnp.int8
     assert engine.cache.k["s"].dtype == jnp.float32
+    assert engine.stats()["kv_quant"] == "int8"
 
     async def run():
         await engine.start()
@@ -139,14 +144,76 @@ def test_engine_e2e_with_kv_quant(quant):
     assert req.finish_reason == "length" and len(req.generated) == 10
 
 
+def test_paged_q8_kernels_match_reference(setup):
+    """Paged decode/prefill kernels over an int8 pool (interpret mode)
+    must match the reference gather+dense path on the same state."""
+    from llmapigateway_tpu.ops.paged_attention import (
+        PagedKVCache, gather_pages, paged_decode_attention, paged_insert_kv,
+        paged_prefill_attention)
+
+    cfg, params = setup
+    B, S, page = 2, 64, 16
+    H, KV, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    NP = S // page
+    num_pages = B * NP + 1
+    rng = np.random.default_rng(3)
+    # Slot b owns pages [1 + b*NP, 1 + (b+1)*NP).
+    table = jnp.asarray(
+        [[1 + b * NP + j for j in range(NP)] for b in range(B)], jnp.int32)
+
+    pool = PagedKVCache.create(cfg, num_pages, page, kv_quant="int8")
+    lk, lv = pool.k, pool.v
+    hist_k = jnp.asarray(rng.standard_normal((B, 48, KV, Dh)), jnp.float32)
+    hist_v = jnp.asarray(rng.standard_normal((B, 48, KV, Dh)), jnp.float32)
+    layer_k = {"q": lk["q"][0], "s": lk["s"][0]}     # layer-0 pool slice
+    layer_v = {"q": lv["q"][0], "s": lv["s"][0]}
+    layer_k, layer_v = paged_insert_kv(layer_k, layer_v, hist_k, hist_v,
+                                       table, jnp.zeros((B,), jnp.int32),
+                                       None)
+
+    lengths = jnp.asarray([37, 48], jnp.int32)
+    q1 = jnp.asarray(rng.standard_normal((B, H, Dh)), jnp.float32)
+    kn = jnp.asarray(rng.standard_normal((B, KV, Dh)), jnp.float32)
+    vn = jnp.asarray(rng.standard_normal((B, KV, Dh)), jnp.float32)
+
+    got = np.asarray(paged_decode_attention(
+        q1, kn, vn, layer_k, layer_v, table, lengths, interpret=True),
+        np.float32)
+    dk = gather_pages(layer_k, table, S)
+    dv = gather_pages(layer_v, table, S)
+    want = np.asarray(llama.dense_decode_attention(
+        q1[:, None], kn[:, None], vn[:, None], dk, dv, lengths)[:, 0],
+        np.float32)
+    np.testing.assert_allclose(got.reshape(want.shape), want,
+                               rtol=2e-3, atol=2e-3)
+
+    # Prefill chunk over the pool.
+    T = 16
+    qT = jnp.asarray(rng.standard_normal((B, T, H, Dh)), jnp.float32)
+    kT = jnp.asarray(rng.standard_normal((B, T, KV, Dh)), jnp.float32)
+    vT = jnp.asarray(rng.standard_normal((B, T, KV, Dh)), jnp.float32)
+    start = jnp.asarray([16, 32], jnp.int32)
+    lk2, lv2 = paged_insert_kv(layer_k, layer_v, kT, vT, table, start, None)
+    got2 = np.asarray(paged_prefill_attention(
+        qT, lk2, lv2, table, start, block_t=8, interpret=True), np.float32)
+    # Exact reference: dense attention over the SAME quantized state
+    # (gather + dequantize the inserted pool — the adapter's reference
+    # path), so both sides see identical int8-rounded K/V.
+    from llmapigateway_tpu.ops.paged_attention import _paged_reference_core
+
+    def deq(d):
+        return d["q"].astype(jnp.float32) * d["s"][..., None]
+    want2 = np.asarray(_paged_reference_core(
+        qT, deq(gather_pages(lk2, table, S)),
+        deq(gather_pages(lv2, table, S)), start, None, T), np.float32)
+    np.testing.assert_allclose(got2, want2, rtol=2e-3, atol=2e-3)
+
+
 def test_kv_quant_guardrails():
     from llmapigateway_tpu.engine.engine import InferenceEngine
 
     base = dict(preset="tiny-test", max_batch_size=1, max_seq_len=64,
                 compilation_cache_dir="off")
-    with pytest.raises(ValueError, match="contiguous"):
-        InferenceEngine(LocalEngineConfig(kv_quant="int8",
-                                          kv_layout="paged", **base))
     with pytest.raises(ValueError, match="kv_quant"):
         InferenceEngine(LocalEngineConfig(kv_quant="int4", **base))
     # Speculation's exact-greedy guarantee can't hold against a quantized
