@@ -10,9 +10,11 @@ import sys
 import time
 import urllib.request
 
-ATTEMPTS = 3
-TIMEOUT_S = 4.0
-BACKOFF_S = 1.0
+# Env-overridable: slow hosts (or a CI box under load) can give the
+# probe more room without editing the image.
+ATTEMPTS = int(os.environ.get("HEALTHCHECK_ATTEMPTS", "3"))
+TIMEOUT_S = float(os.environ.get("HEALTHCHECK_TIMEOUT_S", "4.0"))
+BACKOFF_S = float(os.environ.get("HEALTHCHECK_BACKOFF_S", "1.0"))
 
 
 def main() -> int:

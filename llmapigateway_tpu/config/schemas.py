@@ -58,10 +58,10 @@ class LocalEngineConfig(BaseModel):
     # single-process, no seq/pipe sharding.
     spec_draft_len: int = 0
     # Weight quantization: "int8" stores the seven big matmul weights per
-    # layer + lm_head as symmetric per-channel int8 (activations quantize
-    # dynamically inside the step; models/quant.py). Halves the weight
-    # bytes each decode step streams from HBM — the decode roofline —
-    # at a small accuracy cost (standard W8A8). Llama-family only (v1).
+    # layer (incl. MoE expert matmuls) + lm_head as symmetric per-channel
+    # int8 (activations quantize dynamically inside the step;
+    # models/quant.py). Halves the weight bytes each decode step streams
+    # from HBM — the decode roofline — at a small accuracy cost (W8A8).
     quant: str = ""                 # "" | "int8"
     # KV-cache quantization: "int8" stores K/V as symmetric per-token
     # per-head int8 (+ fp32 scales, ~6% overhead) — halves KV bandwidth

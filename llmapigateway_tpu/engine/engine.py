@@ -248,9 +248,6 @@ class InferenceEngine:
         if self.quant not in QUANT_MODES:
             raise ValueError(f"unknown quant {self.quant!r}; "
                              f"expected one of {QUANT_MODES}")
-        if self.quant and model_cfg.is_moe:
-            raise ValueError("quant='int8' supports the llama family only "
-                             "(MoE expert matmuls are not quantized in v1)")
         # KV-cache quantization (int8 K/V + per-token scales).
         self.kv_quant = engine_cfg.kv_quant
         if self.kv_quant not in ("", "int8"):

@@ -42,6 +42,7 @@ import jax.numpy as jnp
 
 from . import llama
 from .config import ModelConfig
+from .quant import moe_mm_batched, moe_mm_dense
 
 Params = dict[str, Any]
 
@@ -98,10 +99,12 @@ def moe_mlp_dense(x: jax.Array, lp: Params, config: ModelConfig) -> jax.Array:
     B, T, D = x.shape
     xf = x.reshape(B * T, D)
     probs = route(xf, lp["router"], config.experts_per_token)   # [N, E]
-    # Batched expert FFN over the expert dim: [E, N, F].
-    h = jnp.einsum("nd,edf->enf", xf, lp["wg"])
-    u = jnp.einsum("nd,edf->enf", xf, lp["wu"])
-    y = jnp.einsum("enf,efd->end", jax.nn.silu(h) * u, lp["wd"])
+    # Batched expert FFN over the expert dim: [E, N, F]. Expert weights
+    # may be int8 {"q","s"} dicts (models/quant.py) — the moe_mm helpers
+    # dispatch, like `mm` does for the dense family.
+    h = moe_mm_dense(xf, lp["wg"])
+    u = moe_mm_dense(xf, lp["wu"])
+    y = moe_mm_batched(jax.nn.silu(h) * u, lp["wd"])
     out = jnp.einsum("end,ne->nd", y.astype(jnp.float32), probs)
     return out.reshape(B, T, D).astype(x.dtype)
 
@@ -133,9 +136,9 @@ def moe_mlp_dispatch(x: jax.Array, lp: Params, config: ModelConfig,
     combine = dispatch.astype(jnp.float32) * probs[..., None]    # [N, E, C]
 
     xs = jnp.einsum("nd,nec->ecd", xf, dispatch)                 # [E, C, D]
-    h = jnp.einsum("ecd,edf->ecf", xs, lp["wg"])
-    u = jnp.einsum("ecd,edf->ecf", xs, lp["wu"])
-    ys = jnp.einsum("ecf,efd->ecd", jax.nn.silu(h) * u, lp["wd"])
+    h = moe_mm_batched(xs, lp["wg"])
+    u = moe_mm_batched(xs, lp["wu"])
+    ys = moe_mm_batched(jax.nn.silu(h) * u, lp["wd"])
     out = jnp.einsum("ecd,nec->nd", ys.astype(jnp.float32), combine)
     return out.reshape(B, T, D).astype(x.dtype)
 

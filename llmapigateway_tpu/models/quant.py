@@ -73,8 +73,9 @@ def quantize_array(w: jax.Array, contract_axis: int) -> dict[str, jax.Array]:
 
 
 def quantizes(path: str) -> bool:
-    """Whether a param path participates in int8 quantization (v1 surface:
-    llama-family stacked layer matmuls + lm_head; MoE stays bf16)."""
+    """Whether a param path participates in int8 quantization: the
+    llama-family stacked layer matmuls, MoE expert matmuls, and lm_head
+    (norms, biases, router, and the embed table stay full precision)."""
     if path in QUANT_TOP_KEYS:
         return True
     return (path.startswith("layers.")
@@ -84,10 +85,11 @@ def quantizes(path: str) -> bool:
 def contract_axis_for(path: str, ndim: int) -> int | None:
     """Which axis a quantized *stacked* weight contracts over, or None if
     the param doesn't quantize. Paths follow parallel/sharding.py's dot-key
-    scheme. MoE expert weights (ndim 4) return None — not quantized in v1
-    (the engine rejects quant for MoE models outright)."""
-    if not quantizes(path) or ndim == 4:
+    scheme."""
+    if not quantizes(path):
         return None
+    if ndim == 4:   # MoE expert [L, E, D_in, D_out] → per-(e, out) scale
+        return 2
     return 1        # lm_head [V, D] → per-V; layers [L, D_in, D_out] → dim 1
 
 
@@ -95,9 +97,6 @@ def quantize_tree(params: dict, config: ModelConfig) -> dict:
     """Replace every quantizable leaf of a params tree with its
     ``{"q", "s"}`` dict (random-init path; checkpoint load quantizes
     per-parameter on the host instead — engine/checkpoint.py put hook)."""
-    if config.is_moe:
-        raise ValueError("quant='int8' supports the llama family only "
-                         "(MoE expert matmuls are not quantized in v1)")
     out: dict = {}
     for key, val in params.items():
         if key == "layers":
@@ -134,6 +133,34 @@ def mm(x: jax.Array, w: Any) -> jax.Array:
         xq, w["q"], (((x.ndim - 1,), (0,)), ((), ())),
         preferred_element_type=jnp.int32)
     y = acc.astype(jnp.float32) * xs * w["s"]
+    return y.astype(x.dtype)
+
+
+def moe_mm_dense(x: jax.Array, w: Any) -> jax.Array:
+    """All-experts projection: ``x [N, D] × w [E, D, F] → [E, N, F]``
+    (mixtral's dense-routing form), plain or int8 ``{"q","s"}`` (scale
+    ``s [E, F]``). Activations quantize once per row, shared by all E."""
+    if not is_quantized(w):
+        return jnp.einsum("nd,edf->enf", x, w)
+    xq, xs = _dynamic_int8(x)                       # [N, D], [N, 1]
+    acc = jax.lax.dot_general(
+        xq, w["q"], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32)           # [N, E, F]
+    y = acc.astype(jnp.float32) * xs[:, :, None] * w["s"][None]
+    return y.transpose(1, 0, 2).astype(x.dtype)
+
+
+def moe_mm_batched(x: jax.Array, w: Any) -> jax.Array:
+    """Expert-batched projection: ``x [E, C, Din] × w [E, Din, Dout] →
+    [E, C, Dout]`` (mixtral's capacity-dispatch form and both down
+    projections), plain or int8 (scale ``s [E, Dout]``)."""
+    if not is_quantized(w):
+        return jnp.einsum("ecd,edf->ecf", x, w)
+    xq, xs = _dynamic_int8(x)                       # [E, C, Din], [E, C, 1]
+    acc = jax.lax.dot_general(
+        xq, w["q"], (((2,), (1,)), ((0,), (0,))),
+        preferred_element_type=jnp.int32)           # [E, C, Dout]
+    y = acc.astype(jnp.float32) * xs * w["s"][:, None, :]
     return y.astype(x.dtype)
 
 

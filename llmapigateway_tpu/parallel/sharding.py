@@ -53,8 +53,13 @@ def _spec_for(path: str, shape: tuple[int, ...], mesh: Mesh) -> P:
         key = base.split(".", 1)[1] if base.startswith("layers.") else base
         lp = _axis(mesh, "pipe", shape[0])
         if key in ("wq", "wk", "wv", "wg", "wu"):   # column-parallel [L, out]
+            if len(shape) == 3:                     # MoE expert [L, E, F]
+                return P(lp, _axis(mesh, "expert", shape[1]),
+                         _axis(mesh, "model", shape[2]))
             return P(lp, _axis(mesh, "model", shape[1]))
         if key in ("wo", "wd"):                     # row-parallel: out replicated
+            if len(shape) == 3:                     # MoE expert [L, E, D]
+                return P(lp, _axis(mesh, "expert", shape[1]), None)
             return P(lp, None)
         return P()
     if path == "embed" or path == "lm_head":

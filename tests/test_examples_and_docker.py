@@ -61,7 +61,12 @@ def test_healthcheck_exit_codes(tmp_path, monkeypatch):
             # to_thread: subprocess.run would block the loop serving /health.
             ok = await aio.to_thread(
                 subprocess.run, [sys.executable, str(hc)],
-                env={"GATEWAY_PORT": str(port), "PATH": "/usr/bin:/bin"},
+                env={"GATEWAY_PORT": str(port), "PATH": "/usr/bin:/bin",
+                     # Generous budget: on a CI box saturated by a
+                     # concurrent test run the loop serving /health can
+                     # stall past the probe's default 3x4s window.
+                     "HEALTHCHECK_ATTEMPTS": "8",
+                     "HEALTHCHECK_TIMEOUT_S": "10"},
                 capture_output=True)
             assert ok.returncode == 0, ok.stderr
         dead = aiohttp.test_utils.unused_port()
