@@ -495,10 +495,12 @@ def main() -> None:
     ap.add_argument("--spec-draft", type=int, default=3,
                     help="speculative rung draft length (0 disables)")
     ap.add_argument("--spec-bursts", type=int, default=12)
-    ap.add_argument("--max-seconds", type=float, default=900.0,
+    ap.add_argument("--max-seconds", type=float, default=1200.0,
                     help="soft deadline: optional phases are skipped once "
                          "elapsed time passes this, so the one-line JSON "
-                         "always lands inside a driver timeout")
+                         "always lands inside a driver timeout (phases are "
+                         "ordered highest-value first: headline+TTFT, "
+                         "paged, quant rungs, then the rest)")
     args = ap.parse_args()
 
     extra: dict = {}
@@ -564,36 +566,6 @@ def main() -> None:
         extra.setdefault("skipped_phases", []).append(phase)
         return True
 
-    # -- phase 4: mid-size preset (MFU-vs-width rung) ------------------------
-    if args.second_preset and not over_budget("second_preset"):
-        try:
-            engine, init_s = build_engine(args, "contiguous",
-                                          preset=args.second_preset)
-            r = fill_and_time_decode(engine, args, steps=args.second_steps)
-            r["preset"] = args.second_preset
-            r["init_s"] = init_s
-            extra["second_preset"] = r
-            del engine
-        except Exception as e:
-            errors.append(f"second_preset: {e!r}")
-            note(f"FAILED second-preset phase: {e!r}")
-
-    # -- phase 4b: batch-scaling rung (same model, bs=32) --------------------
-    if (args.scale_batch and args.scale_batch != args.batch
-            and not over_budget("batch_scale")):
-        try:
-            engine, init_s = build_engine(args, "contiguous",
-                                          batch=args.scale_batch)
-            r = fill_and_time_decode(engine, args, steps=args.scale_steps)
-            extra["batch_scale"] = {
-                "batch": args.scale_batch, "tok_s": r["tok_s"],
-                "ms_per_decode_step": r["ms_per_decode_step"],
-                "mfu": r["mfu"], "hbm_gbps": r["hbm_gbps"]}
-            del engine
-        except Exception as e:
-            errors.append(f"batch_scale: {e!r}")
-            note(f"FAILED batch-scale phase: {e!r}")
-
     # -- phase 4d: int8 weight-quantization rung -----------------------------
     # Same shape as the headline; decode is weight-bandwidth-bound, so int8
     # weights should land near 2× the bf16 tok/s (models/quant.py). Reported
@@ -641,6 +613,36 @@ def main() -> None:
         except Exception as e:
             errors.append(f"quant_kv: {e!r}")
             note(f"FAILED quant_kv phase: {e!r}")
+
+    # -- phase 4: mid-size preset (MFU-vs-width rung) ------------------------
+    if args.second_preset and not over_budget("second_preset"):
+        try:
+            engine, init_s = build_engine(args, "contiguous",
+                                          preset=args.second_preset)
+            r = fill_and_time_decode(engine, args, steps=args.second_steps)
+            r["preset"] = args.second_preset
+            r["init_s"] = init_s
+            extra["second_preset"] = r
+            del engine
+        except Exception as e:
+            errors.append(f"second_preset: {e!r}")
+            note(f"FAILED second-preset phase: {e!r}")
+
+    # -- phase 4b: batch-scaling rung (same model, bs=32) --------------------
+    if (args.scale_batch and args.scale_batch != args.batch
+            and not over_budget("batch_scale")):
+        try:
+            engine, init_s = build_engine(args, "contiguous",
+                                          batch=args.scale_batch)
+            r = fill_and_time_decode(engine, args, steps=args.scale_steps)
+            extra["batch_scale"] = {
+                "batch": args.scale_batch, "tok_s": r["tok_s"],
+                "ms_per_decode_step": r["ms_per_decode_step"],
+                "mfu": r["mfu"], "hbm_gbps": r["hbm_gbps"]}
+            del engine
+        except Exception as e:
+            errors.append(f"batch_scale: {e!r}")
+            note(f"FAILED batch-scale phase: {e!r}")
 
     # -- phase 4f: long-context rung (bf16 KV vs int8 KV) --------------------
     # At ctx ~2k+ the live KV bytes rival the weight bytes, so this is the
