@@ -209,6 +209,37 @@ def test_paged_q8_kernels_match_reference(setup):
     np.testing.assert_allclose(got2, want2, rtol=2e-3, atol=2e-3)
 
 
+async def test_engine_pallas_with_kv_quant_matches_reference():
+    """attention=pallas + kv_quant (the best single-chip configuration)
+    serves through the interpret-mode q8 kernels and produces the same
+    greedy tokens as the reference path on the same quantized cache."""
+    from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
+
+    from tests.conftest import cpu_devices
+
+    async def run(attention):
+        cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=1,
+                                max_seq_len=64, prefill_chunk=16,
+                                decode_burst=2, kv_quant="int8",
+                                attention=attention,
+                                prewarm_sampler_variants=False,
+                                compilation_cache_dir="off")
+        eng = InferenceEngine(cfg, devices=[cpu_devices()[0]])
+        await eng.start()
+        req = GenRequest(prompt_ids=list(range(2, 20)), max_tokens=6,
+                         temperature=0.0)
+        await eng.submit(req)
+        async for _ in eng.stream(req):
+            pass
+        await eng.stop()
+        return req
+
+    got = await run("pallas")
+    ref = await run("reference")
+    assert got.generated == ref.generated
+    assert got.finish_reason == ref.finish_reason
+
+
 def test_kv_quant_guardrails():
     from llmapigateway_tpu.engine.engine import InferenceEngine
 

@@ -276,6 +276,36 @@ def test_moe_sharded_quant_forward_matches():
                                rtol=2e-3, atol=2e-3)
 
 
+async def test_seq_sharded_engine_with_quant_matches_single_device():
+    """Weight quant composes with sequence parallelism: a ring-attention
+    seq=4 engine with int8 weights produces the single-device quantized
+    engine's exact greedy tokens (weights replicate over `seq`; the int8
+    dots are unsharded per-chip math, so parity is exact)."""
+    from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
+
+    async def run(mesh, devs):
+        cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+                                max_seq_len=128, prefill_chunk=32,
+                                dtype="float32", decode_burst=2,
+                                quant="int8", mesh=mesh,
+                                attention="reference",
+                                prewarm_sampler_variants=False,
+                                compilation_cache_dir="off")
+        eng = InferenceEngine(cfg, devices=devs)
+        await eng.start()
+        req = GenRequest(prompt_ids=list(range(2, 40)), max_tokens=6,
+                         temperature=0.0)
+        await eng.submit(req)
+        async for _ in eng.stream(req):
+            pass
+        await eng.stop()
+        return req
+
+    ref = await run({}, [cpu_devices()[0]])
+    got = await run({"seq": 4}, cpu_devices()[:4])
+    assert got.generated == ref.generated
+
+
 def test_moe_engine_e2e_with_quant():
     from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
 
