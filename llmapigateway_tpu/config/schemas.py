@@ -29,7 +29,6 @@ class LocalEngineConfig(BaseModel):
     model_config = ConfigDict(extra="forbid")
 
     model_path: str = ""            # HF checkpoint dir (safetensors); "" → random init
-    architecture: str = "llama"     # model family key in models/registry.py
     preset: str | None = None       # named config (e.g. "tinyllama-1.1b") when no checkpoint
     dtype: str = "bfloat16"
     # Mesh geometry: axis name -> size. Product must equal device count used.
