@@ -254,10 +254,14 @@ class InferenceEngine:
             raise ValueError(f"unknown kv_quant {self.kv_quant!r}; "
                              f"expected '' | 'int8'")
         if self.kv_quant:
-            if self.seq_n > 1 or self.pipe_n > 1:
-                raise ValueError("kv_quant='int8' does not compose with "
-                                 "seq/pipe sharding (v1: the ring/staged "
-                                 "attention paths read plain cache blocks)")
+            if self.pipe_n > 1:
+                raise ValueError(
+                    "kv_quant='int8' does not compose with pipeline "
+                    "sharding (v1: the staged block's shard_map prefix "
+                    "specs assume plain 5-D cache leaves). Sequence "
+                    "sharding composes: the ring/ulysses ops attend fresh "
+                    "q/k/v and the S-sharded insert/decode paths are "
+                    "quantization-aware.")
             if engine_cfg.spec_draft_len:
                 raise ValueError(
                     "kv_quant='int8' does not compose with speculative "

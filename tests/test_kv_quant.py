@@ -240,6 +240,39 @@ async def test_engine_pallas_with_kv_quant_matches_reference():
     assert got.finish_reason == ref.finish_reason
 
 
+async def test_seq_sharded_engine_with_kv_quant():
+    """kv_quant composes with sequence parallelism: the ring prefill
+    attends fresh q/k/v, the S-sharded {q,s} cache leaves take the
+    quantizing insert, and GSPMD partitions the dict-aware decode. The
+    seq=4 engine must match the single-device int8-cache engine exactly
+    (same quantized values, per-chip fp math on replicated weights)."""
+    from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
+    from tests.conftest import cpu_devices
+
+    async def run(mesh, devs):
+        cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+                                max_seq_len=128, prefill_chunk=32,
+                                dtype="float32", decode_burst=2,
+                                kv_quant="int8", mesh=mesh,
+                                attention="reference",
+                                prewarm_sampler_variants=False,
+                                compilation_cache_dir="off")
+        eng = InferenceEngine(cfg, devices=devs)
+        await eng.start()
+        req = GenRequest(prompt_ids=list(range(2, 40)), max_tokens=6,
+                         temperature=0.0)
+        await eng.submit(req)
+        async for _ in eng.stream(req):
+            pass
+        await eng.stop()
+        return req
+
+    ref = await run({}, [cpu_devices()[0]])
+    got = await run({"seq": 4}, cpu_devices()[:4])
+    assert got.generated == ref.generated
+    assert got.finish_reason == ref.finish_reason
+
+
 def test_kv_quant_guardrails():
     from llmapigateway_tpu.engine.engine import InferenceEngine
 
