@@ -20,6 +20,11 @@ from functools import partial
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+
+# Honor JAX_PLATFORMS=cpu even where a site plugin re-forces the TPU
+# platform after env parsing (a dead tunnel would hang the tool).
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 import numpy as np
 
@@ -35,10 +40,19 @@ def build(args):
     c = get_preset(args.preset)
     key = jax.random.PRNGKey(0)
     t0 = time.monotonic()
-    params = jax.jit(partial(llama.init_params, c, dtype=jnp.bfloat16))(key)
+
+    def init(k):
+        p = llama.init_params(c, k, dtype=jnp.bfloat16)
+        if args.quant:
+            from llmapigateway_tpu.models.quant import quantize_tree
+            p = quantize_tree(p, c)
+        return p
+    params = jax.jit(init)(key)
     jax.block_until_ready(params)
-    note(f"params on device in {time.monotonic() - t0:.1f}s")
-    cache = llama.KVCache.create(c, args.batch, args.seq)
+    note(f"params on device in {time.monotonic() - t0:.1f}s"
+         + (" (int8 weights)" if args.quant else ""))
+    cache = llama.KVCache.create(c, args.batch, args.seq,
+                                 kv_quant="int8" if args.kv_quant else "")
     return c, params, cache
 
 
@@ -172,6 +186,10 @@ def main():
                     "noattn,nomlp")
     ap.add_argument("--pallas", action="store_true",
                     help="also run `full` with the pallas attention_fn")
+    ap.add_argument("--quant", action="store_true",
+                    help="int8 weights (models/quant.py)")
+    ap.add_argument("--kv-quant", action="store_true",
+                    help="int8 KV cache")
     args = ap.parse_args()
 
     note(f"backend: {jax.default_backend()} {jax.devices()}")

@@ -25,6 +25,11 @@ def main():
     args = ap.parse_args()
 
     import jax
+
+# Honor JAX_PLATFORMS=cpu even where a site plugin re-forces the TPU
+# platform after env parsing (a dead tunnel would hang the tool).
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
     from llmapigateway_tpu.config.schemas import LocalEngineConfig
     from llmapigateway_tpu.engine.engine import InferenceEngine

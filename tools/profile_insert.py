@@ -22,6 +22,11 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
+
+# Honor JAX_PLATFORMS=cpu even where a site plugin re-forces the TPU
+# platform after env parsing (a dead tunnel would hang the tool).
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
