@@ -39,6 +39,14 @@ Two KV layouts, selected by ``kv_layout``: the dense per-slot cache
 (ops/paged_attention.py ``PagedKVCache`` + engine/paged.py allocator) where
 admission reserves pages for a request's whole lifetime — page exhaustion
 is backpressure at admission, never a mid-generation failure.
+
+Two independent int8 precision knobs (models/quant.py): ``quant`` stores
+every matmul weight as per-channel int8 (W8A8 on the MXU's native int8
+path — decode is weight-bandwidth-bound, so ~2× tok/s) and ``kv_quant``
+stores K/V as per-token int8 (halves KV bandwidth and capacity; both
+layouts). Both are plain ``{"q","s"}`` dict leaves in the params/cache
+pytrees, so sharding, scanning, and multihost transport treat them
+uniformly.
 """
 from __future__ import annotations
 
