@@ -15,6 +15,7 @@ PROC_ID = int(sys.argv[1])
 N_PROC = int(sys.argv[2])
 PORT = sys.argv[3]
 KV_LAYOUT = sys.argv[4] if len(sys.argv) > 4 else "contiguous"
+QUANT = sys.argv[5] if len(sys.argv) > 5 else ""
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
@@ -38,7 +39,8 @@ MAX_REC = 64
 cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2, max_seq_len=64,
                         prefill_chunk=8, decode_burst=4,
                         mesh={"model": 4}, attention="reference",
-                        kv_layout=KV_LAYOUT, kv_page_size=16)
+                        kv_layout=KV_LAYOUT, kv_page_size=16,
+                        quant=QUANT, kv_quant=QUANT)
 engine = InferenceEngine(cfg)
 assert engine._bridge.enabled, "bridge must be active with 2 processes"
 

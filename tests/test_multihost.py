@@ -25,8 +25,14 @@ def _free_port() -> str:
 import pytest
 
 
-@pytest.mark.parametrize("kv_layout", ["contiguous", "paged"])
-def test_two_process_lockstep_serving(kv_layout):
+@pytest.mark.parametrize("kv_layout,quant", [
+    ("contiguous", ""), ("paged", ""),
+    # Fully-int8 lockstep: the jitted sharded param init must be
+    # deterministic across processes (same program + key → identical
+    # int8 weights), and the quantized decode must stay bit-identical.
+    ("contiguous", "int8"),
+])
+def test_two_process_lockstep_serving(kv_layout, quant):
     env = {**os.environ,
            "JAX_PLATFORMS": "cpu",
            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
@@ -34,7 +40,7 @@ def test_two_process_lockstep_serving(kv_layout):
     port = _free_port()
     procs = [subprocess.Popen(
         [sys.executable, str(ROOT / "tests" / "multihost_worker.py"),
-         str(i), "2", port, kv_layout],
+         str(i), "2", port, kv_layout, quant],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True) for i in range(2)]
     outs = []
