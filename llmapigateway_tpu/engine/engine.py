@@ -622,12 +622,6 @@ class InferenceEngine:
         from ..ops.paged_attention import PagedKVCache, make_paged_attention_fn
 
         impl = self._resolve_attention_impl()
-        if self.kv_quant and impl == "pallas" and self.mesh.size > 1:
-            # Same v1 exclusion as the dense path: the shard_map wrapper's
-            # prefix specs assume plain pool leaves.
-            logger.warning("attention: kv_quant + multi-chip pallas not "
-                           "supported (v1) — using the reference path")
-            impl = "reference"
         mesh = self.mesh if self.mesh.size > 1 else None
         logger.info("paged KV cache: %d pages × %d tokens, attention=%s",
                     self.allocator.num_pages, self.allocator.page_size, impl)
@@ -752,16 +746,9 @@ class InferenceEngine:
         impl = self._resolve_attention_impl()
         if impl == "pallas":
             if self.mesh.size > 1:
-                if self.kv_quant:
-                    # The shard_map wrapper's prefix specs assume plain
-                    # 4-D cache leaves; the {"q","s"} scale leaf is 3-D.
-                    # The jnp path partitions fine under GSPMD (v1).
-                    logger.warning(
-                        "attention: kv_quant + multi-chip pallas not "
-                        "supported (v1) — using the reference path")
-                    return None
                 # Sharded cache → the kernels must run under shard_map
-                # (pallas_call has no GSPMD partitioning rule).
+                # (pallas_call has no GSPMD partitioning rule). The
+                # wrapper's per-leaf specs cover int8 {"q","s"} caches.
                 from ..ops import make_sharded_cache_attention_fn
                 logger.info("attention: pallas flash kernels (shard_map over "
                             "%s)", dict(self.mesh.shape))

@@ -409,7 +409,7 @@ def make_sharded_cache_attention_fn(mesh, block_s: int | None = None,
 
     def _axes(q, layer_k):
         B, _, H, _ = q.shape
-        KV = layer_k.shape[1]
+        KV = (layer_k["q"] if isinstance(layer_k, dict) else layer_k).shape[1]
         msize = mesh.shape.get("model", 1)
         dsize = mesh.shape.get("data", 1)
         model = "model" if (msize > 1 and KV % msize == 0 and H % msize == 0) \
@@ -417,13 +417,22 @@ def make_sharded_cache_attention_fn(mesh, block_s: int | None = None,
         data = "data" if (dsize > 1 and B % dsize == 0) else None
         return model, data, {ax for ax in (model, data) if ax}
 
+    def _cache_spec(side, data, model):
+        """Per-leaf spec: an int8 {"q","s"} cache leaf carries a 4-D value
+        + 3-D scale plane (scale spec = value spec minus head_dim) — a
+        prefix spec would rank-mismatch the scale leaf."""
+        val = P(data, model, None, None)
+        if isinstance(side, dict):
+            return {"q": val, "s": P(data, model, None)}
+        return val
+
     def attention_fn(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
         model, data, manual = _axes(q, layer_k)
         if not manual:
             return base(q, k_new, v_new, layer_k, layer_v, lengths, active)
 
         head = P(data, None, model, None)       # q / k_new / v_new
-        cache = P(data, model, None, None)      # layer_k / layer_v
+        cache = _cache_spec(layer_k, data, model)
         slot = P(data)                          # lengths / active
         # `active=None` means "all slots live" — materialize it so the
         # shard_map signature is static.
@@ -444,7 +453,7 @@ def make_sharded_cache_attention_fn(mesh, block_s: int | None = None,
             return base.decode(q, k_new, v_new, layer_k, layer_v, lengths,
                                active)
         head = P(data, None, model, None)
-        cache = P(data, model, None, None)
+        cache = _cache_spec(layer_k, data, model)
         slot = P(data)
         act = active if active is not None \
             else jnp.ones((q.shape[0],), bool)

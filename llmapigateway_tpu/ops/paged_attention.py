@@ -453,6 +453,15 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
             return d["q"].astype(dtype) * d["s"][..., None].astype(dtype)
         return d
 
+    def _pool_spec(side):
+        """Per-leaf shard_map spec for a per-layer pool side: the int8
+        scale plane is 3-D ([P, KV, page] — the 4-D value minus head_dim),
+        so a prefix spec would rank-mismatch it."""
+        val = P(None, "model", None, None)
+        if isinstance(side, dict):
+            return {"q": val, "s": P(None, "model", None)}
+        return val
+
     def attention_fn(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
         B, T, H, Dh = q.shape
         quant = isinstance(layer_k, dict)
@@ -467,9 +476,8 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
             out = _paged_reference_core(q, dense_k, dense_v, lengths,
                                         active, T)
             return out, layer_k, layer_v
-        shard = (msize > 1 and KV % msize == 0 and H % msize == 0
-                 and not quant)
-        pool = P(None, "model", None, None)
+        shard = msize > 1 and KV % msize == 0 and H % msize == 0
+        pool = _pool_spec(layer_k)
         bt = block_t if block_t is not None else min(T & (-T), 128)
         if shard:
             f = jax.shard_map(
@@ -501,9 +509,8 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
             dense_v = gather_pages(layer_v, page_table, max_seq)
             return dense_decode_attention(q, k_new, v_new, dense_k, dense_v,
                                           n_stale, None)
-        shard = (msize > 1 and KV % msize == 0 and H % msize == 0
-                 and not quant)
-        pool = P(None, "model", None, None)
+        shard = msize > 1 and KV % msize == 0 and H % msize == 0
+        pool = _pool_spec(layer_k)
         if shard:
             f = jax.shard_map(
                 lambda q_, kn_, vn_, k_, v_, pt_, nv_: paged_decode_attention(

@@ -273,6 +273,49 @@ async def test_seq_sharded_engine_with_kv_quant():
     assert got.finish_reason == ref.finish_reason
 
 
+@pytest.mark.parametrize("kv_quant", ["", "int8"])
+def test_paged_sharded_adapter_matches_reference(setup, kv_quant):
+    """The paged adapter's shard_map branch (model-axis manual kernels)
+    must match the gather+dense reference on the same pool — for both the
+    plain and the int8 pool (per-leaf {q,s} specs)."""
+    from jax.sharding import Mesh
+    from llmapigateway_tpu.ops.paged_attention import (
+        PagedKVCache, make_paged_attention_fn, paged_insert_kv)
+    from tests.conftest import cpu_devices
+
+    cfg, params = setup
+    B, S, page = 2, 64, 16
+    H, KV, Dh = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    NP = S // page
+    rng = np.random.default_rng(6)
+    table = jnp.asarray(
+        [[1 + b * NP + j for j in range(NP)] for b in range(B)], jnp.int32)
+    pool = PagedKVCache.create(cfg, B * NP + 1, page, dtype=jnp.float32,
+                               kv_quant=kv_quant)
+    pick = (lambda side: {"q": side["q"][0], "s": side["s"][0]}) \
+        if kv_quant else (lambda side: side[0])
+    layer_k, layer_v = pick(pool.k), pick(pool.v)
+    hist_k = jnp.asarray(rng.standard_normal((B, 40, KV, Dh)), jnp.float32)
+    hist_v = jnp.asarray(rng.standard_normal((B, 40, KV, Dh)), jnp.float32)
+    layer_k, layer_v = paged_insert_kv(layer_k, layer_v, hist_k, hist_v,
+                                       table, jnp.zeros((B,), jnp.int32),
+                                       None)
+    lengths = jnp.asarray([25, 40], jnp.int32)
+    q1 = jnp.asarray(rng.standard_normal((B, 1, H, Dh)), jnp.float32)
+    kn = jnp.asarray(rng.standard_normal((B, 1, KV, Dh)), jnp.float32)
+    vn = jnp.asarray(rng.standard_normal((B, 1, KV, Dh)), jnp.float32)
+
+    mesh = Mesh(np.array(cpu_devices()[:2]), ("model",))
+    shard_attn = make_paged_attention_fn(table, max_seq=S, impl="pallas",
+                                         interpret=True, mesh=mesh)
+    ref_attn = make_paged_attention_fn(table, max_seq=S, impl="reference")
+    got = np.asarray(
+        shard_attn.decode(q1, kn, vn, layer_k, layer_v, lengths), np.float32)
+    want = np.asarray(
+        ref_attn.decode(q1, kn, vn, layer_k, layer_v, lengths), np.float32)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+
 def test_kv_quant_guardrails():
     from llmapigateway_tpu.engine.engine import InferenceEngine
 
