@@ -576,8 +576,18 @@ def forward(params: Params, config: ModelConfig, tokens: jax.Array,
         new_k, new_v = ys_k, ys_v
 
     x = rms_norm(x, params["final_norm"], c.rms_eps, c.rms_offset)
-    head = params["embed"] if c.tie_embeddings else params["lm_head"]
+    head = _select_head(params, c)
     # bf16 (or int8) reads of the [V, D] head with MXU accumulation — an
     # explicit astype would materialize a fp32 copy of the vocab matrix.
     logits = head_matmul(x, head)
     return logits, KVCache(k=new_k, v=new_v)
+
+
+def _select_head(params: Params, c: ModelConfig):
+    """The LM head weight: ``lm_head`` (untied), or for tied-embedding
+    models the int8 head copy ``lm_head_q8`` when quantized (models/
+    quant.py quantize_tree) else the embed table itself."""
+    if c.tie_embeddings:
+        return params["lm_head_q8"] if "lm_head_q8" in params \
+            else params["embed"]
+    return params["lm_head"]

@@ -96,7 +96,14 @@ def contract_axis_for(path: str, ndim: int) -> int | None:
 def quantize_tree(params: dict, config: ModelConfig) -> dict:
     """Replace every quantizable leaf of a params tree with its
     ``{"q", "s"}`` dict (random-init path; checkpoint load quantizes
-    per-parameter on the host instead — engine/checkpoint.py put hook)."""
+    per-parameter on the host instead — engine/checkpoint.py put hook).
+
+    Tied-embedding models (qwen2/gemma families) have no ``lm_head`` leaf;
+    the embed table stays full precision (the gather path reads only B
+    rows/step), but the HEAD read — the full ``[V, D]`` matrix every step,
+    ~25% of gemma-2b's weight bytes — gets its own int8 copy under
+    ``lm_head_q8``. +0.5× embed bytes of storage buys a 2× smaller
+    per-step head read, which is the bandwidth that matters at decode."""
     out: dict = {}
     for key, val in params.items():
         if key == "layers":
@@ -110,6 +117,8 @@ def quantize_tree(params: dict, config: ModelConfig) -> dict:
             out[key] = quantize_array(val, contract_axis_for(key, val.ndim))
         else:
             out[key] = val
+    if config.tie_embeddings and "lm_head" not in params:
+        out["lm_head_q8"] = quantize_array(params["embed"], 1)
     return out
 
 

@@ -38,6 +38,10 @@ def _axis(mesh: Mesh, name: str, dim_size: int) -> str | None:
 
 # param path (dot key) → function(shape, mesh) -> PartitionSpec
 def _spec_for(path: str, shape: tuple[int, ...], mesh: Mesh) -> P:
+    # The tied-embedding int8 head copy (models/quant.py quantize_tree)
+    # shards exactly like a real lm_head.
+    if path.startswith("lm_head_q8"):
+        path = "lm_head" + path[len("lm_head_q8"):]
     # Int8-quantized weights (models/quant.py) add ".q"/".s" sub-leaves:
     # the int8 tensor shards exactly like the bf16 weight it replaces; the
     # per-output-channel scale shards like the weight's output dim (so a

@@ -156,8 +156,8 @@ def test_pipelined_forward_with_quant(quant_setup):
                                     "tiny-gemma-test"])
 def test_engine_e2e_with_quant(preset):
     """Engine with quant="int8" serves a greedy request end to end, for
-    every non-MoE family (qwen2 exercises the bias path, gemma the
-    tied-embedding head staying bf16)."""
+    every non-MoE family (qwen2 exercises the bias path, gemma/qwen the
+    tied-embedding int8 head copy)."""
     from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
 
     cfg = LocalEngineConfig(preset=preset, max_batch_size=2,
@@ -169,6 +169,11 @@ def test_engine_e2e_with_quant(preset):
     # Weights really are int8 on device.
     assert engine.params["layers"]["wq"]["q"].dtype == jnp.int8
     assert engine.stats()["quant"] == "int8"
+    if engine.model_cfg.tie_embeddings:
+        # Tied models get the int8 HEAD copy (the full-[V,D]-read-per-step
+        # tensor); the embed table itself stays full precision for gathers.
+        assert engine.params["lm_head_q8"]["q"].dtype == jnp.int8
+        assert not is_quantized(engine.params["embed"])
 
     async def run():
         await engine.start()
@@ -209,6 +214,69 @@ def test_checkpoint_load_quantizes_on_host(tmp_path):
     assert engine.params["layers"]["wd"]["q"].dtype == jnp.int8
     assert engine.params["layers"]["wd"]["s"].dtype == jnp.float32
     assert engine.params["lm_head"]["q"].shape == (128, 64)
+
+    first, engine.cache = engine._exec_prefill(
+        0, 0, np.arange(1, 9, dtype=np.int32))
+    assert 0 <= int(np.asarray(first)) < 128
+
+
+def test_tied_head_quant_fidelity_and_structure():
+    """Tied-embedding quantize_tree adds the ``lm_head_q8`` int8 head copy
+    (ADVICE r3: without it, gemma-2b's 256k×2048 tied table — ~25% of its
+    weight bytes — stayed bf16 under quant="int8"); the quantized forward
+    must track the fp32 one within W8A8 noise."""
+    cfg = get_preset("tiny-qwen-test")
+    params = llama.init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    qparams = quantize_tree(params, cfg)
+    assert is_quantized(qparams["lm_head_q8"])
+    assert qparams["lm_head_q8"]["q"].shape == params["embed"].shape
+    assert qparams["lm_head_q8"]["s"].shape == (cfg.vocab_size,)
+    assert not is_quantized(qparams["embed"])
+
+    B, T, S = 2, 8, 32
+    rng = np.random.default_rng(7)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, T)), jnp.int32)
+    lengths = jnp.zeros((B,), jnp.int32)
+
+    def run(p):
+        cache = llama.KVCache.create(cfg, B, S, dtype=jnp.float32)
+        logits, _ = llama.forward(p, cfg, tokens, lengths, cache)
+        return np.asarray(logits, np.float64)
+
+    ref, got = run(params), run(qparams)
+    rel = np.linalg.norm(got - ref) / np.linalg.norm(ref)
+    assert rel < 0.05, rel
+
+
+def test_checkpoint_tied_head_quantizes_on_device(tmp_path):
+    """A TIED checkpoint (no lm_head tensor) under quant="int8" gets its
+    head copy synthesized on device post-load (engine/_init_params)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from llmapigateway_tpu.engine.engine import InferenceEngine
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rms_norm_eps=1e-5,
+        tie_word_embeddings=True)
+    torch.manual_seed(1)
+    transformers.LlamaForCausalLM(hf_cfg).save_pretrained(
+        tmp_path, safe_serialization=True)
+
+    cfg = LocalEngineConfig(model_path=str(tmp_path), max_batch_size=1,
+                            max_seq_len=64, prefill_chunk=16, decode_burst=2,
+                            quant="int8", prewarm_sampler_variants=False,
+                            compilation_cache_dir="off")
+    engine = InferenceEngine(cfg)
+    assert engine.params["lm_head_q8"]["q"].dtype == jnp.int8
+    assert engine.params["lm_head_q8"]["q"].shape == (128, 64)
+    # The q8 copy must BE a quantization of the loaded embed table.
+    deq = (np.asarray(engine.params["lm_head_q8"]["q"], np.float32)
+           * np.asarray(engine.params["lm_head_q8"]["s"])[:, None])
+    emb = np.asarray(engine.params["embed"], np.float32)
+    lsb = np.asarray(engine.params["lm_head_q8"]["s"])[:, None]
+    assert np.all(np.abs(deq - emb) <= 0.51 * lsb + 1e-7)
 
     first, engine.cache = engine._exec_prefill(
         0, 0, np.arange(1, 9, dtype=np.int32))
