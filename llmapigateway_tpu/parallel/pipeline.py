@@ -57,7 +57,9 @@ def _block_forward(lp_block: dict, c: ModelConfig, x: jax.Array,
                    cos: jax.Array, sin: jax.Array
                    ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Run one stage's layer block: scan over the local layers.
-    x [Bm, T, D]; k/v_block [Lp, Bm, KV, S, Dh]."""
+    x [Bm, T, D]; k/v_block [Lp, Bm, KV, S, Dh] — or the int8-quantized
+    ``{"q", "s"}`` dict (the scan unstacks dim 0 of every leaf; the
+    attention handles plain-or-quantized via llama._kv_dequant_views)."""
     B, T, _ = x.shape
 
     def layer_step(x, scanned):
@@ -79,7 +81,7 @@ def _block_forward(lp_block: dict, c: ModelConfig, x: jax.Array,
 
 @functools.lru_cache(maxsize=32)
 def _build_run(c: ModelConfig, mesh: Mesh, n_stages: int, M: int, Bm: int,
-               T: int, has_lm_head: bool):
+               T: int, has_lm_head: bool, has_head_q8: bool = False):
     """Build (once per signature) the jitted shard_map pipeline program.
     jax.jit caches by function identity, so the closure must be memoized —
     a fresh closure per call would retrace/recompile every invocation."""
@@ -88,6 +90,8 @@ def _build_run(c: ModelConfig, mesh: Mesh, n_stages: int, M: int, Bm: int,
     param_spec = {"embed": P(), "final_norm": P(), "layers": P("pipe")}
     if has_lm_head:
         param_spec["lm_head"] = P()
+    if has_head_q8:
+        param_spec["lm_head_q8"] = P()     # prefix spec covers {q, s}
     in_specs = (
         param_spec,
         P(),                     # tokens (replicated; every stage embeds)
@@ -129,15 +133,23 @@ def _build_run(c: ModelConfig, mesh: Mesh, n_stages: int, M: int, Bm: int,
             x_in = jnp.where(p == 0, x_all[mc], inbuf)
             mb_len = len_all[mc]
             mb_act = act_all[mc] & valid            # bubbles → tail writes
-            k_rows = jax.lax.dynamic_slice_in_dim(cache_k, mc * Bm, Bm, 1)
-            v_rows = jax.lax.dynamic_slice_in_dim(cache_v, mc * Bm, Bm, 1)
+            # Tree-mapped batch slicing: an int8-quantized cache is a
+            # {"q": [L,B,KV,S,Dh], "s": [L,B,KV,S]} dict — the batch dim
+            # is axis 1 of EVERY leaf, so one per-leaf slice covers both
+            # layouts (VERDICT r3 item 7: kv_quant × PP).
+            def rows(cache):
+                return jax.tree.map(
+                    lambda a: jax.lax.dynamic_slice_in_dim(
+                        a, mc * Bm, Bm, 1), cache)
             y, k_rows, v_rows = _block_forward(
-                lp, c, x_in, mb_len, k_rows, v_rows, mb_act,
+                lp, c, x_in, mb_len, rows(cache_k), rows(cache_v), mb_act,
                 cos_all[mc], sin_all[mc])
-            cache_k = jax.lax.dynamic_update_slice_in_dim(
-                cache_k, k_rows, mc * Bm, 1)
-            cache_v = jax.lax.dynamic_update_slice_in_dim(
-                cache_v, v_rows, mc * Bm, 1)
+            cache_k = jax.tree.map(
+                lambda full, r: jax.lax.dynamic_update_slice_in_dim(
+                    full, r, mc * Bm, 1), cache_k, k_rows)
+            cache_v = jax.tree.map(
+                lambda full, r: jax.lax.dynamic_update_slice_in_dim(
+                    full, r, mc * Bm, 1), cache_v, v_rows)
             # Last stage collects its finished microbatch.
             take = valid & (p == n_stages - 1)
             outs = jax.lax.cond(
@@ -161,7 +173,7 @@ def _build_run(c: ModelConfig, mesh: Mesh, n_stages: int, M: int, Bm: int,
         # masked psum broadcasts the logits to every stage.
         x = outs.reshape(B, T, -1)
         x = llama.rms_norm(x, params["final_norm"], c.rms_eps, c.rms_offset)
-        head = params["embed"] if c.tie_embeddings else params["lm_head"]
+        head = llama._select_head(params, c)
         logits = llama.head_matmul(x, head)   # plain bf16 or int8 {q,s} head
         logits = jnp.where(p == n_stages - 1, logits, 0.0)
         logits = jax.lax.psum(logits, "pipe")
@@ -191,7 +203,7 @@ def pipelined_forward(params: dict, config: ModelConfig, tokens: jax.Array,
     if active is None:
         active = jnp.ones((B,), bool)
     run = _build_run(config, mesh, n_stages, M, B // M, T,
-                     "lm_head" in params)
+                     "lm_head" in params, "lm_head_q8" in params)
     logits, new_k, new_v = run(params, tokens, lengths, cache.k, cache.v,
                                active)
     return logits, llama.KVCache(k=new_k, v=new_v)
