@@ -36,9 +36,11 @@ class LocalEngineConfig(BaseModel):
     max_batch_size: int = 8
     max_seq_len: int = 4096
     kv_layout: str = "contiguous"   # "contiguous" | "paged"
-    # Page size doubles as the paged kernel's DMA block; 256 is the
-    # measured-optimal block on v5e (128 costs ~10% decode throughput).
-    kv_page_size: int = 256
+    # Page size doubles as the paged kernel's DMA block; 128 is the
+    # PAGED kernel's measured optimum on v5e (1500.5 vs 1322.3 tok/s at
+    # 256, TinyLlama bs=8). The dense kernel's 256-block optimum does
+    # not transfer to the paged kernel (bench.py paged_sweep).
+    kv_page_size: int = 128
     kv_num_pages: int = 0           # 0 → derived from max_batch_size*max_seq_len
     prefill_chunk: int = 512
     decode_burst: int = 8           # chained decode steps per host sync
@@ -56,6 +58,19 @@ class LocalEngineConfig(BaseModel):
     # the normal (unaccelerated) decode path. Works with both KV layouts;
     # single-process, no seq/pipe sharding.
     spec_draft_len: int = 0
+    # Adaptive drafting gate: a speculative step is a T=k+1 verify forward
+    # (~1.2-1.3x a T=1 step's device time), so drafting only pays while
+    # accepted tokens/step clears that ratio. The engine keeps a per-slot
+    # acceptance EMA and falls back to NORMAL decode bursts while the
+    # active batch's mean is below this threshold — so spec can stay
+    # enabled in config without taxing non-repetitive traffic. While
+    # gated off, one 1-step speculative PROBE runs every
+    # `spec_probe_interval` decode rounds to re-measure (text often turns
+    # repetitive mid-stream: quoting, code, lists). 0 disables the gate
+    # (always draft). New/unmeasured slots count optimistically so fresh
+    # requests get a chance to establish their rate.
+    spec_min_tokens_per_step: float = 1.2
+    spec_probe_interval: int = 25
     # Weight quantization: "int8" stores the seven big matmul weights per
     # layer (incl. MoE expert matmuls) + lm_head as symmetric per-channel
     # int8 (activations quantize dynamically inside the step;

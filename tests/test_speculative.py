@@ -201,6 +201,68 @@ async def test_spec_runs_to_cache_end_via_normal_fallback():
         assert len(req.generated) == 192 - eng.spec_k - 1 - 48
 
 
+async def test_adaptive_gate_closes_on_low_acceptance():
+    """VERDICT r3 item 5: with the acceptance gate on, a batch whose
+    measured acceptance can't clear the threshold must fall back to
+    NORMAL decode bursts (drafting off) — and the output must still be
+    the exact greedy sequence. An impossible threshold (> k+1) makes the
+    closure deterministic regardless of the text."""
+    rng = np.random.default_rng(11)
+    prompt = list(rng.integers(2, 500, 40))
+    ref_eng = _engine(spec=0)
+    try:
+        ref = await _gen(ref_eng, prompt, max_tokens=40)
+    finally:
+        await ref_eng.stop()
+    eng = _engine(spec=3, spec_min_tokens_per_step=5.0,
+                  spec_probe_interval=1000)
+    try:
+        got = await _gen(eng, prompt, max_tokens=40)
+        assert got.generated == ref.generated
+        # Only the initial optimistic burst(s) speculated; once measured,
+        # every step ran through the normal path.
+        assert eng._spec_steps_done <= 2 * eng._spec_scan_len, \
+            eng._spec_steps_done
+        stats = eng.stats()
+        assert stats["spec_gate_open"] is False
+        assert stats["spec_ema_tokens_per_step"] <= 4.0
+    finally:
+        await eng.stop()
+
+
+async def test_adaptive_gate_probes_while_closed():
+    """While gated off, a 1-step speculative probe must run every
+    `spec_probe_interval` rounds so mid-stream repetitive text can
+    re-open the gate."""
+    rng = np.random.default_rng(12)
+    prompt = list(rng.integers(2, 500, 40))
+    eng = _engine(spec=3, spec_min_tokens_per_step=5.0,
+                  spec_probe_interval=4)
+    try:
+        await _gen(eng, prompt, max_tokens=60)
+        first_bursts = eng._spec_scan_len  # the initial optimistic burst
+        # ≥ one probe fired beyond the initial burst (60 steps at
+        # interval 4 → many), each exactly 1 step wide.
+        assert eng._spec_steps_done > first_bursts, eng._spec_steps_done
+    finally:
+        await eng.stop()
+
+
+async def test_adaptive_gate_stays_open_on_repetitive_text():
+    """Default gate (1.2 tok/step): repetitive text keeps acceptance
+    high, so drafting stays engaged and still beats 1 token/step."""
+    rng = np.random.default_rng(13)
+    prompt = list(np.tile(rng.integers(2, 500, 4), 10))
+    eng = _engine(spec=3)       # default spec_min_tokens_per_step=1.2
+    try:
+        await _gen(eng, prompt, max_tokens=40)
+        stats = eng.stats()
+        assert stats["spec_tokens_per_step"] > 1.0, stats
+        assert stats["spec_gate_open"] is True
+    finally:
+        await eng.stop()
+
+
 def test_spec_config_guardrails():
     with pytest.raises(ValueError, match="1, 3, 7"):
         _engine(spec=4)
