@@ -20,7 +20,10 @@ def test_bench_emits_contract_json_line():
          "--second-preset", "tiny-test", "--second-steps", "4",
          "--scale-batch", "4", "--scale-steps", "4",
          "--long-seq", "128", "--long-prompt", "32", "--long-batch", "2",
-         "--long-steps", "4"],
+         "--long-steps", "4",
+         "--eight-b-preset", "tiny-test", "--eight-b-batch", "2",
+         "--eight-b-seq", "128", "--eight-b-steps", "4",
+         "--burst-sweep", "0"],
         capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
     assert r.returncode == 0, r.stderr[-2000:]
     lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
@@ -35,6 +38,10 @@ def test_bench_emits_contract_json_line():
     for field in ("ms_per_decode_step", "prefill_tok_s", "mfu", "hbm_gbps",
                   "roofline_fraction", "paged_tok_s", "second_preset",
                   "batch_scale", "speculative", "quant_int8",
-                  "quant_int8_kv8", "long_ctx"):
+                  "quant_int8_kv8", "long_ctx", "headline_8b",
+                  "paged_sweep", "north_star", "spec_mixed"):
         assert field in extra, (field, sorted(extra))
+    # The paged sweep measured both page sizes and named a winner.
+    assert set(extra["paged_sweep"]) >= {"128", "256", "best_page_size"}
+    assert extra["headline_8b"]["quant"] == "int8"
     assert "phase_errors" not in extra, extra["phase_errors"]
