@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import threading
 import time
 from pathlib import Path
 from typing import Any
@@ -31,6 +32,40 @@ _trace_lock = asyncio.Lock()
 
 MAX_TRACE_MS = 30_000
 DEFAULT_TRACE_MS = 2_000
+
+# Device-inventory probe state. jax.devices() initializes the backend on
+# first call — seconds normally, but through a DEAD remote-TPU tunnel it
+# hangs FOREVER (observed: the axon relay dies for hours and the init never
+# returns). A stats poll must never inherit that fate: exactly ONE daemon
+# thread probes, requests wait a bounded time, and an unfinished probe is
+# reported as status "initializing" instead of hanging the endpoint.
+_dev_state: dict[str, Any] = {"status": "unprobed", "devices": []}
+_dev_lock = threading.Lock()
+
+DEVICE_PROBE_WAIT_S = 5.0
+
+
+def _start_device_probe() -> None:
+    with _dev_lock:
+        # "ok" is cached for the process lifetime; "initializing" means a
+        # probe thread is still out (possibly hung — never stack more).
+        # An "unavailable" FAILURE is retried on the next poll: transient
+        # causes (another process briefly holding the TPU runtime) heal.
+        if _dev_state["status"] in ("initializing", "ok"):
+            return
+        _dev_state["status"] = "initializing"
+
+    def work():
+        try:
+            import jax
+            devs = [{"id": d.id, "platform": d.platform,
+                     "kind": d.device_kind} for d in jax.devices()]
+            _dev_state.update(status="ok", devices=devs)
+        except Exception as e:      # proxy-only deployment without JAX
+            _dev_state.update(status=f"unavailable: {e!r:.120}",
+                              devices=[])
+    threading.Thread(target=work, daemon=True,
+                     name="engine-stats-device-probe").start()
 
 
 def _local_engines(gw) -> list[tuple[str, Any]]:
@@ -45,18 +80,16 @@ def _local_engines(gw) -> list[tuple[str, Any]]:
 async def get_engine_stats(request: web.Request) -> web.Response:
     gw = request.app["gateway"]
     engines = {name: eng.stats() for name, eng in _local_engines(gw)}
-
-    def _devices() -> list[dict[str, Any]]:
-        # jax.devices() initializes the backend on first call (can take
-        # seconds and claims the TPU runtime) — never on the event loop.
-        try:
-            import jax
-            return [{"id": d.id, "platform": d.platform,
-                     "kind": d.device_kind} for d in jax.devices()]
-        except Exception:       # proxy-only deployment without JAX
-            return []
-    devices = await asyncio.to_thread(_devices)
-    return web.json_response({"engines": engines, "devices": devices})
+    _start_device_probe()
+    deadline = time.monotonic() + DEVICE_PROBE_WAIT_S
+    while (_dev_state["status"] == "initializing"
+           and time.monotonic() < deadline):
+        await asyncio.sleep(0.05)
+    return web.json_response({
+        "engines": engines,
+        "devices": _dev_state["devices"],
+        "device_status": _dev_state["status"],
+    })
 
 
 async def capture_trace(request: web.Request) -> web.Response:

@@ -5,7 +5,20 @@ server is aiohttp. Settings come from ``.env`` / environment
 (GATEWAY_PORT default 9100, GATEWAY_HOST, GATEWAY_API_KEY, FALLBACK_PROVIDER,
 CONFIG_DIR, DB_DIR, LOGS_DIR, LOG_LEVEL, ...).
 """
-from llmapigateway_tpu.server.app import run
+import os
+
+if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+    # Honor JAX_PLATFORMS=cpu even where a site plugin re-forces a remote
+    # TPU platform after env parsing (the config pin wins; the env var
+    # alone is overridden) — a CPU-only gateway must never block on an
+    # unreachable TPU runtime.
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except ImportError:          # proxy-only deployment without JAX
+        pass
+
+from llmapigateway_tpu.server.app import run    # noqa: E402
 
 if __name__ == "__main__":
     run()

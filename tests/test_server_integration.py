@@ -261,6 +261,7 @@ async def test_engine_stats_and_trace_capture(tmp_path):
         body = await resp.json()
         assert body["engines"] == {}
         assert isinstance(body["devices"], list)
+        assert body["device_status"] == "ok"
 
         resp = await g.client.post("/v1/api/profiler/trace?duration_ms=150")
         assert resp.status == 200
@@ -272,6 +273,37 @@ async def test_engine_stats_and_trace_capture(tmp_path):
 
         resp = await g.client.post("/v1/api/profiler/trace?duration_ms=nope")
         assert resp.status == 400
+
+
+async def test_engine_stats_survives_hung_backend_init(tmp_path,
+                                                       monkeypatch):
+    """A jax backend whose init HANGS (dead remote-TPU tunnel — observed
+    for hours at a time) must not hang the stats endpoint: the probe runs
+    in one daemon thread and the request returns within the bounded wait
+    with device_status "initializing" (regression: found live — the
+    endpoint inherited the hang and curl never returned)."""
+    import time as _time
+    from llmapigateway_tpu.server import profiler_api
+
+    monkeypatch.setattr(profiler_api, "DEVICE_PROBE_WAIT_S", 0.3)
+    monkeypatch.setattr(profiler_api, "_dev_state",
+                        {"status": "unprobed", "devices": []})
+
+    def hang():
+        _time.sleep(60)
+    monkeypatch.setattr(
+        profiler_api, "_start_device_probe",
+        lambda: (profiler_api._dev_state.update(status="initializing"),
+                 __import__("threading").Thread(
+                     target=hang, daemon=True).start()))
+    async with Gateway(tmp_path) as g:
+        t0 = _time.monotonic()
+        resp = await g.client.get("/v1/api/engine-stats")
+        assert _time.monotonic() - t0 < 5.0
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["device_status"] == "initializing"
+        assert body["devices"] == []
 
 
 async def test_request_payload_logged_redacted(tmp_path, caplog):
