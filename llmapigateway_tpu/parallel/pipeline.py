@@ -54,12 +54,14 @@ def stage_size(n_layers: int, n_stages: int) -> int:
 def _block_forward(lp_block: dict, c: ModelConfig, x: jax.Array,
                    lengths: jax.Array, k_block: jax.Array,
                    v_block: jax.Array, active: jax.Array,
-                   cos: jax.Array, sin: jax.Array
+                   cos: jax.Array, sin: jax.Array, mlp_fn=None
                    ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Run one stage's layer block: scan over the local layers.
     x [Bm, T, D]; k/v_block [Lp, Bm, KV, S, Dh] — or the int8-quantized
     ``{"q", "s"}`` dict (the scan unstacks dim 0 of every leaf; the
-    attention handles plain-or-quantized via llama._kv_dequant_views)."""
+    attention handles plain-or-quantized via llama._kv_dequant_views).
+    ``mlp_fn(h, lp)`` replaces the SwiGLU MLP (the MoE hook — same
+    contract as llama.forward's)."""
     B, T, _ = x.shape
 
     def layer_step(x, scanned):
@@ -72,7 +74,10 @@ def _block_forward(lp_block: dict, c: ModelConfig, x: jax.Array,
             q, k, v, layer_k, layer_v, lengths, active)
         x = x + llama.mm(attn, lp["wo"])
         h = llama.rms_norm(x, lp["mlp_norm"], c.rms_eps, c.rms_offset)
-        x = x + llama.swiglu_mlp(h, lp["wg"], lp["wu"], lp["wd"], c.act)
+        if mlp_fn is not None:
+            x = x + mlp_fn(h, lp)
+        else:
+            x = x + llama.swiglu_mlp(h, lp["wg"], lp["wu"], lp["wd"], c.act)
         return x, (layer_k, layer_v)
 
     x, (new_k, new_v) = jax.lax.scan(layer_step, x, (lp_block, k_block, v_block))
@@ -86,6 +91,18 @@ def _build_run(c: ModelConfig, mesh: Mesh, n_stages: int, M: int, Bm: int,
     jax.jit caches by function identity, so the closure must be memoized —
     a fresh closure per call would retrace/recompile every invocation."""
     B = M * Bm
+    # MoE (mixtral): the staged block runs the family MLP hook per layer
+    # — the scanned lp slice carries router [D,E] + expert stacks, which
+    # is exactly what moe_mlp_* consume. NB the dense/dispatch shape
+    # switch sees the MICROBATCH's N = Bm·T, so a pipelined long prefill
+    # may pick capacity dispatch at a different N than the sequential
+    # forward would — capacity is an approximation knob either way;
+    # decode (T=1) and small chunks always run the exact dense form.
+    if c.is_moe:
+        from ..models import mixtral
+        mlp_fn = mixtral.make_mlp_fn(c)
+    else:
+        mlp_fn = None
     # Spec prefix-trees: P("pipe") applies to every leaf under "layers".
     param_spec = {"embed": P(), "final_norm": P(), "layers": P("pipe")}
     if has_lm_head:
@@ -143,7 +160,7 @@ def _build_run(c: ModelConfig, mesh: Mesh, n_stages: int, M: int, Bm: int,
                         a, mc * Bm, Bm, 1), cache)
             y, k_rows, v_rows = _block_forward(
                 lp, c, x_in, mb_len, rows(cache_k), rows(cache_v), mb_act,
-                cos_all[mc], sin_all[mc])
+                cos_all[mc], sin_all[mc], mlp_fn=mlp_fn)
             cache_k = jax.tree.map(
                 lambda full, r: jax.lax.dynamic_update_slice_in_dim(
                     full, r, mc * Bm, 1), cache_k, k_rows)

@@ -155,7 +155,7 @@ async def test_engine_serves_with_pipeline_stages():
     assert toks_pp == toks_ref, (toks_pp, toks_ref)
 
 
-async def test_engine_pipe_rejects_paged_and_moe():
+async def test_engine_pipe_rejects_paged():
     import pytest
     from llmapigateway_tpu.config.schemas import LocalEngineConfig
     from llmapigateway_tpu.engine.engine import InferenceEngine
@@ -165,8 +165,71 @@ async def test_engine_pipe_rejects_paged_and_moe():
             preset="tiny-test", max_batch_size=2, max_seq_len=128,
             mesh={"pipe": 2}, kv_layout="paged"),
             devices=jax.devices("cpu")[:2])
-    with pytest.raises(ValueError, match="llama family"):
-        InferenceEngine(LocalEngineConfig(
+
+
+# ---------------------------------------------------------------------------
+# PP × MoE (BASELINE config 5's multi-host Mixtral story): the staged block
+# runs the family MLP hook, so mixtral's router + expert stacks pipeline
+# like any other layer params.
+# ---------------------------------------------------------------------------
+
+def test_pipeline_matches_sequential_moe():
+    """pipelined_forward on a Mixtral config must match mixtral.forward —
+    the scanned lp slice feeds router/expert stacks to moe_mlp_* per
+    layer. Shapes stay under the dispatch threshold so both paths run the
+    exact dense routing (capacity dispatch is N-dependent by design)."""
+    from llmapigateway_tpu.models import mixtral
+    from llmapigateway_tpu.models.config import get_preset
+
+    cfg = get_preset("tiny-moe-test")
+    params = mixtral.init_params(cfg, jax.random.PRNGKey(0),
+                                 dtype=jnp.float32)
+    mesh = build_mesh(MeshSpec(sizes={"pipe": 2}, auto_model=False),
+                      cpu_devices()[:2])
+    B, T, S = 2, 8, 32
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (B, T), 0,
+                                cfg.vocab_size)
+    lengths = jnp.zeros((B,), jnp.int32)
+    ref, _ = mixtral.forward(params, cfg, tokens, lengths,
+                             llama.KVCache.create(cfg, B, S, jnp.float32))
+    got, _ = pipelined_forward(params, cfg, tokens, lengths,
+                               llama.KVCache.create(cfg, B, S, jnp.float32),
+                               mesh, 2)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+async def test_engine_serves_moe_with_pipeline_and_expert_axes():
+    """A Mixtral engine on a pipe×expert mesh (PP staging the layers, EP
+    sharding the experts inside each stage) serves the same greedy tokens
+    as the single-device MoE engine."""
+    from llmapigateway_tpu.config.schemas import LocalEngineConfig
+    from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
+
+    prompt = list((np.arange(40) * 7 + 2) % 500)
+
+    async def run(mesh, devices):
+        cfg = LocalEngineConfig(
             preset="tiny-moe-test", max_batch_size=2, max_seq_len=128,
-            mesh={"pipe": 2}),
-            devices=jax.devices("cpu")[:2])
+            prefill_chunk=32, dtype="float32", mesh=mesh,
+            attention="reference", prewarm_sampler_variants=False,
+            compilation_cache_dir="off")
+        eng = InferenceEngine(cfg, devices=devices)
+        try:
+            req = GenRequest(prompt_ids=list(prompt), max_tokens=6,
+                             temperature=0.0)
+            await eng.submit(req)
+            async for _ in eng.stream(req):
+                pass
+            assert req.finish_reason is not None
+            return eng, req.generated
+        finally:
+            await eng.stop()
+
+    cpus = jax.devices("cpu")
+    eng_pp, toks_pp = await run({"pipe": 2, "expert": 2}, cpus[:4])
+    assert eng_pp.pipe_n == 2
+    wg_spec = eng_pp.params["layers"]["wg"].sharding.spec
+    assert wg_spec[0] == "pipe" and wg_spec[1] == "expert", wg_spec
+    _, toks_ref = await run({}, cpus[:1])
+    assert toks_pp == toks_ref, (toks_pp, toks_ref)
