@@ -55,8 +55,11 @@ class LocalEngineConfig(BaseModel):
     # N+1 must be a power of two (kernel blocking): N ∈ {1, 3, 7}.
     # Engages only while every active slot is greedy; while any
     # temperature>0 request is active the whole batch is served through
-    # the normal (unaccelerated) decode path. Works with both KV layouts;
-    # single-process, no seq/pipe sharding.
+    # the normal (unaccelerated) decode path. Works with both KV
+    # layouts and composes with seq/pipe sharding (the verify forward's
+    # S-reductions partition under GSPMD / run through the staged
+    # block); single-process only, and not with kv_quant (exact-greedy
+    # guarantee).
     spec_draft_len: int = 0
     # Adaptive drafting gate: a speculative step is a T=k+1 verify forward
     # (~1.2-1.3x a T=1 step's device time), so drafting only pays while

@@ -239,10 +239,6 @@ class InferenceEngine:
             if self.seq_n > 1:
                 raise ValueError("mesh axes pipe and seq cannot be "
                                  "combined (pick PP or SP, not both)")
-            if model_cfg.is_moe:
-                raise ValueError(
-                    "pipeline parallelism currently supports the llama "
-                    "family only (MoE layers are not in the staged block)")
             if model_cfg.n_layers % self.pipe_n:
                 raise ValueError(
                     f"n_layers {model_cfg.n_layers} not divisible by "
@@ -282,9 +278,6 @@ class InferenceEngine:
                 raise ValueError(
                     f"spec_draft_len must be one of 1, 3, 7 (verify width "
                     f"k+1 must be a power of two), got {self.spec_k}")
-            if self.seq_n > 1 or self.pipe_n > 1:
-                raise ValueError("speculative decoding does not compose "
-                                 "with seq/pipe sharding (v1)")
             if self._bridge.enabled:
                 raise ValueError("speculative decoding is single-process "
                                  "only (v1): the multihost command stream "
