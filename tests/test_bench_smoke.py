@@ -23,8 +23,8 @@ def test_bench_emits_contract_json_line():
          "--long-steps", "4",
          "--eight-b-preset", "tiny-test", "--eight-b-batch", "2",
          "--eight-b-seq", "128", "--eight-b-steps", "4",
-         "--burst-sweep", "0"],
-        capture_output=True, text=True, timeout=300, env=env, cwd=REPO)
+         "--burst-sweep", "0", "--spec-mixed-tokens", "16"],
+        capture_output=True, text=True, timeout=480, env=env, cwd=REPO)
     assert r.returncode == 0, r.stderr[-2000:]
     lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
     assert len(lines) == 1, f"expected ONE json line, got: {r.stdout!r}"
