@@ -231,11 +231,6 @@ class InferenceEngine:
         # microbatches when divisible (else M=1: correct, bubble-heavy).
         self.pipe_n = self.mesh.shape.get("pipe", 1)
         if self.pipe_n > 1:
-            if self.paged:
-                raise ValueError(
-                    "pipeline parallelism requires kv_layout=contiguous "
-                    "(the pipelined schedule stages the dense per-layer "
-                    "cache; the paged pool has no layer-contiguous rows)")
             if self.seq_n > 1:
                 raise ValueError("mesh axes pipe and seq cannot be "
                                  "combined (pick PP or SP, not both)")
@@ -396,7 +391,9 @@ class InferenceEngine:
                     f"kv_num_pages={num_pages} cannot hold one max-length "
                     f"sequence ({per_slot} pages of {page})")
             self.allocator = PageAllocator(num_pages, page, self.B, self.S)
-            psh = paged_cache_sharding(self.mesh, c.n_kv_heads)
+            psh = paged_cache_sharding(
+                self.mesh, c.n_kv_heads,
+                n_layers=c.n_layers if self.pipe_n > 1 else None)
             shape = (c.n_layers, num_pages, c.n_kv_heads, page, c.head_dim)
             # Layout owned by PagedKVCache.create (the one copy of the
             # int8 {q,s} scheme); 5-D value leaves shard via psh, the 4-D
@@ -645,6 +642,29 @@ class InferenceEngine:
 
         replicated = NamedSharding(self.mesh, P())
 
+        if self.pipe_n > 1:
+            # Paged × PP: the pool's layer dim is staged over `pipe`
+            # (paged_cache_sharding) and the GPipe schedule slices TABLE
+            # rows per microbatch instead of cache rows — the attention
+            # builder must be identity-stable for the pipeline's program
+            # memo, hence ONE partial per engine.
+            make_attn = partial(make_paged_attention_fn, max_seq=S,
+                                impl=impl, mesh=mesh)
+            pipe_fwd = _pipelined_family_forward(self.mesh, self.pipe_n,
+                                                 make_attention=make_attn)
+
+            def call_forward(params, cache, table, tokens, lengths,
+                             active=None):
+                return pipe_fwd(params, c, tokens, lengths, cache,
+                                active=active, table=table)
+        else:
+            def call_forward(params, cache, table, tokens, lengths,
+                             active=None):
+                attn = make_paged_attention_fn(table, max_seq=S, impl=impl,
+                                               mesh=mesh)
+                return family_forward(params, c, tokens, lengths, cache,
+                                      active=active, attention_fn=attn)
+
         @partial(jax.jit, donate_argnums=(1,))
         def prefill_step(params, cache: PagedKVCache, table: jax.Array,
                          tokens: jax.Array, start_len: jax.Array,
@@ -657,10 +677,8 @@ class InferenceEngine:
             — the slot's page-table row does the routing. Returns (first
             sampled token, cache) — sampling folded in, see dense twin."""
             row = jax.lax.dynamic_slice_in_dim(table, slot, 1, axis=0)
-            attn = make_paged_attention_fn(row, max_seq=S, impl=impl,
-                                           mesh=mesh)
-            logits, cache = family_forward(
-                params, c, tokens, start_len[None], cache, attention_fn=attn)
+            logits, cache = call_forward(params, cache, row, tokens,
+                                         start_len[None])
             out = jax.lax.with_sharding_constraint(
                 jax.lax.dynamic_index_in_dim(logits[0], last_idx, 0,
                                              keepdims=False), replicated)
@@ -678,11 +696,9 @@ class InferenceEngine:
             table is loop-invariant under the burst scan — pages are
             reserved for a request's whole lifetime at admission, so no
             page can change mid-burst."""
-            attn = make_paged_attention_fn(table, max_seq=S, impl=impl,
-                                           mesh=mesh)
-            logits, cache = family_forward(
-                params, c, tokens[:, None], lengths, cache, active=active,
-                attention_fn=attn)
+            logits, cache = call_forward(params, cache, table,
+                                         tokens[:, None], lengths,
+                                         active=active)
             if greedy:
                 next_tokens = jnp.argmax(
                     logits[:, 0, :], axis=-1).astype(jnp.int32)
@@ -702,9 +718,10 @@ class InferenceEngine:
             from .speculative import make_spec_burst, make_spec_step
 
             def make_fwd(tbl):
-                attn = make_paged_attention_fn(tbl, max_seq=S, impl=impl,
-                                               mesh=mesh)
-                return partial(family_forward, attention_fn=attn)
+                def fwd(params, c_, tokens, lengths, cache, active=None):
+                    return call_forward(params, cache, tbl, tokens,
+                                        lengths, active=active)
+                return fwd
 
             self._spec_scan_len = max(
                 1, self.decode_burst // (self.spec_k + 1))
@@ -1645,20 +1662,23 @@ class InferenceEngine:
         return out
 
 
-def _pipelined_family_forward(mesh, n_stages: int):
+def _pipelined_family_forward(mesh, n_stages: int, make_attention=None):
     """family-forward adapter running the GPipe schedule
     (parallel/pipeline.py) — same signature contract as llama.forward, so
     the engine's prefill/decode step bodies don't change. Microbatch count
     adapts to the call's batch: `n_stages` when divisible (the schedule's
-    sweet spot), else 1."""
+    sweet spot), else 1 — the ONE copy of that policy for both the dense
+    and the paged pipelines. ``make_attention`` + the ``table`` kwarg
+    switch the schedule to paged mode (parallel/pipeline.py)."""
     from ..parallel.pipeline import pipelined_forward
 
     def fwd(params, c, tokens, lengths, cache, active=None,
-            attention_fn=None, mlp_fn=None):
+            attention_fn=None, mlp_fn=None, table=None):
         B = tokens.shape[0]
         M = n_stages if B % n_stages == 0 else 1
         return pipelined_forward(params, c, tokens, lengths, cache, mesh,
-                                 M, active=active)
+                                 M, active=active,
+                                 make_attention=make_attention, table=table)
 
     return fwd
 

@@ -140,11 +140,15 @@ def cache_sharding(mesh: Mesh, n_kv_heads: int, batch: int,
         _axis(mesh, "seq", max_seq) if max_seq else None, None))
 
 
-def paged_cache_sharding(mesh: Mesh, n_kv_heads: int) -> NamedSharding:
+def paged_cache_sharding(mesh: Mesh, n_kv_heads: int,
+                         n_layers: int | None = None) -> NamedSharding:
     """Paged pool [L, P, KV, page, Dh]: KV heads on model; the page dim is a
-    global pool indexed by the (replicated) page table, so it never shards."""
+    global pool indexed by the (replicated) page table, so it never shards.
+    In a pipelined engine the layer dim stages over ``pipe`` (each stage
+    holds its own layers' pages), mirroring the dense cache_sharding."""
     return NamedSharding(mesh, P(
-        None, None, _axis(mesh, "model", n_kv_heads), None, None))
+        _axis(mesh, "pipe", n_layers) if n_layers else None,
+        None, _axis(mesh, "model", n_kv_heads), None, None))
 
 
 def batch_sharding(mesh: Mesh, batch: int) -> NamedSharding:

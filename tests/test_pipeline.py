@@ -155,16 +155,50 @@ async def test_engine_serves_with_pipeline_stages():
     assert toks_pp == toks_ref, (toks_pp, toks_ref)
 
 
-async def test_engine_pipe_rejects_paged():
-    import pytest
-    from llmapigateway_tpu.config.schemas import LocalEngineConfig
-    from llmapigateway_tpu.engine.engine import InferenceEngine
+# ---------------------------------------------------------------------------
+# PP × PAGED (the headline KV layout on the long-model axis): the pool's
+# layer dim stages over `pipe`; the GPipe tick slices page-TABLE rows per
+# microbatch (the pool has no batch dim) and bubble writes ride the trash
+# page. Composes transitively with kv_quant and speculation.
+# ---------------------------------------------------------------------------
 
-    with pytest.raises(ValueError, match="pipeline parallelism"):
-        InferenceEngine(LocalEngineConfig(
+@pytest.mark.parametrize("engine_kw", [
+    {}, {"kv_quant": "int8"}, {"spec_draft_len": 3}])
+async def test_engine_pipe_with_paged_kv(engine_kw):
+    from llmapigateway_tpu.config.schemas import LocalEngineConfig
+    from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
+
+    # kv_quant × spec stays excluded (exact-greedy guarantee); the two
+    # are parametrized separately on purpose.
+    rng = np.random.default_rng(5)
+    prompt = list(rng.integers(2, 500, 40))
+
+    async def run(mesh, devs):
+        cfg = LocalEngineConfig(
             preset="tiny-test", max_batch_size=2, max_seq_len=128,
-            mesh={"pipe": 2}, kv_layout="paged"),
-            devices=jax.devices("cpu")[:2])
+            prefill_chunk=32, dtype="float32", decode_burst=4,
+            kv_layout="paged", kv_page_size=16, mesh=mesh,
+            attention="reference", prewarm_sampler_variants=False,
+            compilation_cache_dir="off", **engine_kw)
+        eng = InferenceEngine(cfg, devices=devs)
+        try:
+            req = GenRequest(prompt_ids=list(prompt), max_tokens=12,
+                             temperature=0.0)
+            await eng.submit(req)
+            async for _ in eng.stream(req):
+                pass
+            assert req.finish_reason is not None
+            return eng, req.generated
+        finally:
+            await eng.stop()
+
+    cpus = jax.devices("cpu")
+    eng_pp, toks_pp = await run({"pipe": 2}, cpus[:2])
+    pool_k = eng_pp.cache.k["q"] if isinstance(eng_pp.cache.k, dict) \
+        else eng_pp.cache.k
+    assert pool_k.sharding.spec[0] == "pipe"      # layer dim staged
+    _, toks_ref = await run({}, cpus[:1])
+    assert toks_pp == toks_ref, (toks_pp, toks_ref)
 
 
 # ---------------------------------------------------------------------------
