@@ -266,6 +266,20 @@ class InferenceEngine:
                     "them quantized from the cache, so the output would "
                     "no longer be exactly the greedy sequence")
 
+        # Sliding-window attention (mistral family): v1 serves through the
+        # windowed dense paths — full GSPMD DP/TP/PP and speculation
+        # compose; the pallas kernels, the paged pool, and seq sharding
+        # don't carry the window yet and are excluded at build.
+        if model_cfg.sliding_window:
+            if self.paged:
+                raise ValueError(
+                    "sliding-window models need kv_layout=contiguous (v1: "
+                    "the paged kernels don't carry the window bound)")
+            if self.seq_n > 1:
+                raise ValueError(
+                    "sliding-window models do not compose with seq "
+                    "sharding (v1: ring/ulysses attention is unwindowed)")
+
         # Prompt-lookup speculative decoding (engine/speculative.py).
         self.spec_k = max(0, engine_cfg.spec_draft_len)
         if self.spec_k:
@@ -596,7 +610,8 @@ class InferenceEngine:
             # profile_insert.py), paid EVERY spec step otherwise.
             spec_forward = partial(
                 family_forward,
-                attention_fn=_spec_verify_attention_fn(attention_fn))
+                attention_fn=_spec_verify_attention_fn(
+                    attention_fn, window=c.sliding_window))
             self._spec_scan = make_spec_burst(
                 spec_forward, c, self.spec_k, self._spec_scan_len)
             self._spec_step = partial(jax.jit, donate_argnums=(1,))(
@@ -621,6 +636,12 @@ class InferenceEngine:
                 logger.info("attention: reference (seq/pipe-sharded engine "
                             "— Pallas kernels need a full-extent local "
                             "cache)")
+            return "reference"
+        if self.model_cfg.sliding_window:
+            if impl == "pallas":
+                logger.warning("attention=pallas does not carry the "
+                               "sliding-window bound (v1); using the "
+                               "windowed dense reference")
             return "reference"
         if impl == "auto":
             return "pallas" if jax.default_backend() == "tpu" else "reference"
@@ -1683,19 +1704,24 @@ def _pipelined_family_forward(mesh, n_stages: int, make_attention=None):
     return fwd
 
 
-def _spec_verify_attention_fn(base):
+def _spec_verify_attention_fn(base, window: int = 0):
     """Attention provider for the speculative verify forward: the engine's
     configured attention (``base``; None = family default), extended with
     ``.verify`` so the T=k+1 verify step runs deferred-insert block
     attention (llama.dense_verify_attention) instead of the chunk path's
     insert-then-attend. A separate provider — adding ``.verify`` to the
     shared one would silently reroute PREFILL chunks off the Pallas causal
-    kernel too (llama.forward dispatches on the attribute for any T>1)."""
-    base = base if base is not None else llama.dense_cache_attention
+    kernel too (llama.forward dispatches on the attribute for any T>1).
+    ``window``: sliding-window bound for mistral-family engines — threads
+    through the default base AND the verify twin."""
+    if base is None:
+        base = llama.windowed_dense_attention(window) if window \
+            else llama.dense_cache_attention
 
     def attn(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
         return base(q, k_new, v_new, layer_k, layer_v, lengths, active)
-    attn.verify = llama.dense_verify_attention
+    attn.verify = partial(llama.dense_verify_attention, window=window) \
+        if window else llama.dense_verify_attention
     attn.decode = getattr(base, "decode", llama.dense_decode_attention)
     attn.insert_all = getattr(base, "insert_all", llama.insert_kv_stacked)
     return attn
@@ -1866,6 +1892,14 @@ def _config_from_checkpoint(model_path: str) -> ModelConfig:
         return ModelConfig(family="mixtral",
                            n_experts=cfg.get("num_local_experts", 8),
                            experts_per_token=cfg.get("num_experts_per_tok", 2),
+                           **common)
+    if mtype == "mistral":
+        # Mistral = llama block + sliding-window attention (null in
+        # v0.2+ configs → full attention). Explicit head_dim: Nemo-style
+        # checkpoints have head_dim * n_heads != hidden_size.
+        return ModelConfig(family="llama",
+                           sliding_window=cfg.get("sliding_window") or 0,
+                           head_dim_override=cfg.get("head_dim", 0) or 0,
                            **common)
     if mtype == "qwen2":
         return ModelConfig(family="qwen2", attn_bias=True, **common)

@@ -56,6 +56,12 @@ class ModelConfig:
     # Explicit head dim for families where H * Dh != d_model (Gemma-7B:
     # 16 heads x 256 vs d_model 3072). 0 = derive d_model // n_heads.
     head_dim_override: int = 0
+    # Sliding-window attention (mistral-family): position i attends keys
+    # j with i - j < window (self included) — HF Mistral semantics. 0 =
+    # full causal attention. v1 masks only (the linear cache keeps every
+    # token; windowed KV eviction is a capacity optimization, not a
+    # correctness requirement).
+    sliding_window: int = 0
     # MoE (mixtral) fields
     n_experts: int = 0             # 0 → dense
     experts_per_token: int = 2
@@ -106,6 +112,16 @@ PRESETS: dict[str, ModelConfig] = {
     "llama-3b-class": ModelConfig(
         vocab_size=32000, d_model=3072, n_layers=28, n_heads=24,
         n_kv_heads=8, d_ff=8192, rope_theta=10000.0, max_seq_len=2048),
+    # Mistral-7B-v0.1 (HF: mistralai/Mistral-7B-Instruct-v0.1): llama
+    # block + 4096-token sliding-window attention over a 32k context.
+    "mistral-7b": ModelConfig(
+        vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
+        n_kv_heads=8, d_ff=14336, rope_theta=10000.0, max_seq_len=32768,
+        sliding_window=4096),
+    # Tiny sliding-window model for tests (window << max_seq).
+    "tiny-mistral-test": ModelConfig(
+        vocab_size=512, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=256, sliding_window=16),
     # Llama-3-8B (HF: meta-llama/Meta-Llama-3-8B-Instruct).
     "llama-3-8b": ModelConfig(
         vocab_size=128256, d_model=4096, n_layers=32, n_heads=32,

@@ -19,7 +19,7 @@ attention/norm blocks Mixtral reuses (models/mixtral.py).
 """
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Any, Callable, NamedTuple
 
 import jax
@@ -288,7 +288,8 @@ def insert_kv_stacked(cache_k, cache_v,
 def dense_decode_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
                            layer_k: jax.Array, layer_v: jax.Array,
                            lengths: jax.Array,
-                           active: jax.Array | None = None) -> jax.Array:
+                           active: jax.Array | None = None,
+                           window: int = 0) -> jax.Array:
     """Deferred-insert decode attention: one query token against the STALE
     cache prefix ``[0, lengths)`` plus the new token itself (self-column).
 
@@ -320,6 +321,12 @@ def dense_decode_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
                         preferred_element_type=jnp.float32) * scale
 
     visible = jnp.arange(S)[None, :] < lengths[:, None]            # [B, S]
+    if window:
+        # Sliding window (HF Mistral semantics): the query at position
+        # `lengths` sees keys j with lengths - j < window; the self
+        # column is always in-window.
+        visible = visible & (jnp.arange(S)[None, :]
+                             > (lengths - window)[:, None])
     if active is not None:
         visible = visible & active[:, None]
     scores = jnp.where(visible[:, None, None, :], scores, -1e30)
@@ -350,7 +357,8 @@ def _kv_dequant_views(layer_k, layer_v, dtype):
 def dense_verify_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
                            layer_k: jax.Array, layer_v: jax.Array,
                            lengths: jax.Array,
-                           active: jax.Array | None = None) -> jax.Array:
+                           active: jax.Array | None = None,
+                           window: int = 0) -> jax.Array:
     """Deferred-insert BLOCK attention: T new tokens attend the STALE cache
     prefix ``[0, lengths)`` plus a causal self-block of themselves — the
     T>1 generalization of :func:`dense_decode_attention` (T=1 self-column).
@@ -362,7 +370,8 @@ def dense_verify_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     clean S-reductions under GSPMD (same rationale as the decode twin).
 
     q [B,T,H,Dh]; k_new/v_new [B,T,KV,Dh]; layer_k/v [B,KV,S,Dh] (stale).
-    Returns out [B, T, H*Dh]; writes nothing.
+    Returns out [B, T, H*Dh]; writes nothing. ``window``: sliding-window
+    bound (0 = full causal).
     """
     B, T, H, Dh = q.shape
     KV = k_new.shape[2]
@@ -382,12 +391,27 @@ def dense_verify_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
                         preferred_element_type=jnp.float32) * scale
 
     visible = jnp.arange(S)[None, :] < lengths[:, None]            # [B, S]
-    if active is not None:
-        visible = visible & active[:, None]
-    scores = jnp.where(visible[:, None, None, None, :], scores, -1e30)
+    if window:
+        # Query t sits at position lengths + t: stale key j visible iff
+        # (lengths + t) - j < window — a per-(B, T) bound.
+        q_pos = lengths[:, None] + jnp.arange(T)[None, :]          # [B, T]
+        in_win = (jnp.arange(S)[None, None, :]
+                  > (q_pos - window)[:, :, None])                  # [B, T, S]
+        vis_ts = visible[:, None, :] & in_win
+        if active is not None:
+            vis_ts = vis_ts & active[:, None, None]
+        scores = jnp.where(vis_ts[:, None, None, :, :], scores, -1e30)
+    else:
+        if active is not None:
+            visible = visible & active[:, None]
+        scores = jnp.where(visible[:, None, None, None, :], scores, -1e30)
     # Self-block: new token u is visible to query t iff u <= t (the query
     # itself is always visible, so the softmax denominator is >= 1).
     causal = (jnp.arange(T)[None, :] <= jnp.arange(T)[:, None])    # [T, T]
+    if window:
+        # Within-block window: u visible to t iff t - u < window.
+        causal = causal & (jnp.arange(T)[None, :]
+                           > jnp.arange(T)[:, None] - window)
     self_s = jnp.where(causal[None, None, None], self_s, -1e30)
 
     m = jnp.maximum(jnp.max(scores, axis=-1), jnp.max(self_s, axis=-1))
@@ -408,7 +432,8 @@ def dense_verify_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
 def dense_cache_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
                           layer_k: jax.Array, layer_v: jax.Array,
                           lengths: jax.Array,
-                          active: jax.Array | None = None
+                          active: jax.Array | None = None,
+                          window: int = 0
                           ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Reference cache attention (pure jnp; the Pallas paged kernel replaces
     this on TPU — ops/paged_attention.py).
@@ -417,6 +442,8 @@ def dense_cache_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     k_new:  [B, T, KV, Dh], v_new same — new tokens to insert at `lengths`.
     layer_k/v: [B, KV, S, Dh] — this layer's cache (head-major).
     lengths: [B] int32 — tokens already cached (insert offset).
+    window: sliding-window bound (0 = full causal; HF Mistral semantics —
+    query at position i sees keys j with i - j < window).
     Returns (attn_out [B, T, H*Dh], updated layer_k, layer_v).
     """
     B, T, H, Dh = q.shape
@@ -439,10 +466,13 @@ def dense_cache_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     if ks is not None:
         scores = scores * ks[:, :, None, None, :]
 
-    # Mask: key position s is visible to query t iff s <= lengths + t.
+    # Mask: key position s is visible to query t iff s <= lengths + t
+    # (and, with a sliding window, within `window` of it).
     q_pos = lengths[:, None] + jnp.arange(T)[None, :]          # [B, T]
     s_idx = jnp.arange(S)[None, None, :]                        # [1, 1, S]
     visible = s_idx <= q_pos[:, :, None]                        # [B, T, S]
+    if window:
+        visible = visible & (s_idx > q_pos[:, :, None] - window)
     if active is not None:
         visible = visible & active[:, None, None]
     scores = jnp.where(visible[:, None, None, :, :], scores, -1e30)
@@ -462,6 +492,23 @@ def dense_cache_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
 # and the cache write happens once per step via insert_kv_stacked.
 dense_cache_attention.decode = dense_decode_attention
 dense_cache_attention.insert_all = insert_kv_stacked
+
+
+@lru_cache(maxsize=8)
+def windowed_dense_attention(window: int):
+    """The default dense provider with a sliding-window bound threaded
+    through every path (chunk, deferred decode, spec verify) —
+    ``forward`` swaps it in for ``config.sliding_window`` models
+    (mistral family). Memoized so the provider identity is stable."""
+    def fn(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
+        return dense_cache_attention(q, k_new, v_new, layer_k, layer_v,
+                                     lengths, active, window=window)
+    fn.decode = partial(dense_decode_attention, window=window)
+    # No ``.verify`` here: that attribute reroutes EVERY T>1 call (prefill
+    # chunks included) through the deferred block path — the spec engine
+    # adds its windowed verify via _spec_verify_attention_fn instead.
+    fn.insert_all = insert_kv_stacked
+    return fn
 
 
 _GATE_ACTS = {
@@ -518,6 +565,11 @@ def forward(params: Params, config: ModelConfig, tokens: jax.Array,
     c = config
     B, T = tokens.shape
     dh = c.head_dim
+    if c.sliding_window and attention_fn is dense_cache_attention:
+        # Mistral-family sliding window, threaded through the default
+        # dense provider (explicit providers — pallas/seq/paged — are
+        # excluded for SWA models at engine build).
+        attention_fn = windowed_dense_attention(c.sliding_window)
 
     x = jnp.take(params["embed"], tokens, axis=0)   # [B, T, D]
     if c.scale_embed:

@@ -77,7 +77,11 @@ def _block_forward(lp_block: dict, c: ModelConfig, x: jax.Array,
     differently and would flip greedy ties against the non-pipelined
     engine). Chunks always insert-then-attend."""
     B, T, _ = x.shape
-    attend = attention_fn or llama.dense_cache_attention
+    if attention_fn is None and c.sliding_window:
+        # Mistral-family: the default dense path carries the window.
+        attend = llama.windowed_dense_attention(c.sliding_window)
+    else:
+        attend = attention_fn or llama.dense_cache_attention
     decode_attend = insert_all = None
     if T == 1 and attention_fn is not None:
         decode_attend = getattr(attention_fn, "decode", None)
