@@ -637,11 +637,13 @@ class InferenceEngine:
                             "— Pallas kernels need a full-extent local "
                             "cache)")
             return "reference"
-        if self.model_cfg.sliding_window:
+        if self.model_cfg.sliding_window and self.mesh.size > 1:
+            # Single-device SWA runs the windowed flash kernels; the
+            # shard_map wrapper doesn't thread the window yet (v1).
             if impl == "pallas":
                 logger.warning("attention=pallas does not carry the "
-                               "sliding-window bound (v1); using the "
-                               "windowed dense reference")
+                               "sliding-window bound on a multi-chip mesh "
+                               "(v1); using the windowed dense reference")
             return "reference"
         if impl == "auto":
             return "pallas" if jax.default_backend() == "tpu" else "reference"
@@ -808,8 +810,10 @@ class InferenceEngine:
                             "%s)", dict(self.mesh.shape))
                 return make_sharded_cache_attention_fn(self.mesh)
             from ..ops import make_cache_attention_fn
-            logger.info("attention: pallas flash kernels")
-            return make_cache_attention_fn()
+            w = self.model_cfg.sliding_window
+            logger.info("attention: pallas flash kernels%s",
+                        f" (sliding window {w})" if w else "")
+            return make_cache_attention_fn(window=w)
         return None
 
     def _enable_debug_nans(self) -> None:

@@ -567,8 +567,10 @@ def forward(params: Params, config: ModelConfig, tokens: jax.Array,
     dh = c.head_dim
     if c.sliding_window and attention_fn is dense_cache_attention:
         # Mistral-family sliding window, threaded through the default
-        # dense provider (explicit providers — pallas/seq/paged — are
-        # excluded for SWA models at engine build).
+        # dense provider. Explicit providers must carry the window
+        # themselves: the engine builds the flash kernels with it
+        # (single-device), and excludes seq/paged/multi-chip-pallas for
+        # SWA models at build.
         attention_fn = windowed_dense_attention(c.sliding_window)
 
     x = jnp.take(params["embed"], tokens, axis=0)   # [B, T, D]

@@ -118,7 +118,8 @@ def unpack_kv_refs(refs):
     return k_ref, None, v_ref, None, o_ref, m_ref, l_ref, acc_ref
 
 
-def _decode_kernel(nvalid_ref, q_ref, kn_ref, vn_ref, *refs, block_s: int):
+def _decode_kernel(nvalid_ref, q_ref, kn_ref, vn_ref, *refs, block_s: int,
+                   window: int = 0):
     k_ref, ks_ref, v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = \
         unpack_kv_refs(refs)
     b = pl.program_id(0)
@@ -130,13 +131,23 @@ def _decode_kernel(nvalid_ref, q_ref, kn_ref, vn_ref, *refs, block_s: int):
         self_column_init(q_ref, kn_ref, vn_ref, m_ref, l_ref, acc_ref)
 
     n_valid = nvalid_ref[b]
+    # Sliding window: the query (at position n_valid) sees stale keys j
+    # with n_valid - j < window, i.e. j >= w0. Blocks entirely below w0
+    # skip compute (and their DMA is elided by the index-map clamp).
+    w0 = jnp.maximum(n_valid - (window - 1), 0) if window else 0
+    live = s * block_s < n_valid
+    if window:
+        live = live & ((s + 1) * block_s > w0)
 
-    @pl.when(s * block_s < n_valid)
+    @pl.when(live)
     def _block():
         def mask(scores):
             s_global = s * block_s + jax.lax.broadcasted_iota(
                 jnp.int32, scores.shape, 1)
-            return jnp.where(s_global < n_valid, scores, NEG_INF)
+            ok = s_global < n_valid
+            if window:
+                ok = ok & (s_global >= w0)
+            return jnp.where(ok, scores, NEG_INF)
         attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask,
                      ks_ref, vs_ref)
 
@@ -150,6 +161,7 @@ def flash_decode_attention(q: jax.Array, k_new: jax.Array,
                            v_new: jax.Array, layer_k, layer_v,
                            n_stale: jax.Array,
                            *, block_s: int = 128,
+                           window: int = 0,
                            interpret: bool | None = None) -> jax.Array:
     """Ragged single-token attention over a STALE cache plus the new token.
 
@@ -159,7 +171,9 @@ def flash_decode_attention(q: jax.Array, k_new: jax.Array,
     the int8 ``{"q","s"}`` dicts (models/llama.py kv_quant layout — the
     kernel gains per-token scale blocks, see :func:`attend_block`);
     n_stale: [B] int32 — visible stale prefix per slot (the query's
-    position; 0 for a fresh slot). Returns [B, H * Dh] in q.dtype.
+    position; 0 for a fresh slot). ``window``: sliding-window bound
+    (mistral family; 0 = full) — out-of-window leading blocks skip both
+    compute and DMA. Returns [B, H * Dh] in q.dtype.
     """
     B, H, Dh = q.shape
     quant = isinstance(layer_k, dict)
@@ -172,17 +186,26 @@ def flash_decode_attention(q: jax.Array, k_new: jax.Array,
     qg = q.reshape(B, KV, G, Dh)
     grid = (B, KV, S // block_s)
 
+    def _live_range(nv_b):
+        """(first, last) live block for a slot — iterations outside re-
+        reference a live block so the pipeline elides their DMA (pl.when
+        already skips their compute). max() guards n_stale == 0 (fresh
+        slot: all cache blocks dead, only the self column counts)."""
+        last = jnp.maximum((nv_b + block_s - 1) // block_s - 1, 0)
+        if window:
+            first = jnp.maximum(nv_b - (window - 1), 0) // block_s
+            first = jnp.minimum(first, last)
+        else:
+            first = 0
+        return first, last
+
     def kv_index(b, h, s, nv):
-        # Clamp to the slot's last live block: iterations past n_stale re-
-        # reference the previous block, so the pipeline elides their DMA
-        # (pl.when already skips their compute). max() guards n_stale == 0
-        # (fresh slot: all cache blocks dead, only the self column counts).
-        last = jnp.maximum((nv[b] + block_s - 1) // block_s - 1, 0)
-        return b, h, jnp.minimum(s, last), 0
+        first, last = _live_range(nv[b])
+        return b, h, jnp.clip(s, first, last), 0
 
     def scale_index(b, h, s, nv):
-        last = jnp.maximum((nv[b] + block_s - 1) // block_s - 1, 0)
-        return b, h, jnp.minimum(s, last)
+        first, last = _live_range(nv[b])
+        return b, h, jnp.clip(s, first, last)
 
     kv_spec = pl.BlockSpec((1, 1, block_s, Dh), kv_index)
     s_spec = pl.BlockSpec((1, 1, block_s), scale_index)
@@ -194,7 +217,7 @@ def flash_decode_attention(q: jax.Array, k_new: jax.Array,
         kv_specs = [kv_spec, kv_spec]
 
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, block_s=block_s),
+        functools.partial(_decode_kernel, block_s=block_s, window=window),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
@@ -223,7 +246,8 @@ def flash_decode_attention(q: jax.Array, k_new: jax.Array,
 # Prefill kernel: q [B, T, H, Dh] vs cache [B, KV, S, Dh], causal from start
 # ---------------------------------------------------------------------------
 
-def _prefill_kernel(start_ref, q_ref, *refs, block_t: int, block_s: int):
+def _prefill_kernel(start_ref, q_ref, *refs, block_t: int, block_s: int,
+                    window: int = 0):
     k_ref, ks_ref, v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = \
         unpack_kv_refs(refs)
     b = pl.program_id(0)
@@ -240,17 +264,25 @@ def _prefill_kernel(start_ref, q_ref, *refs, block_t: int, block_s: int):
     start = start_ref[b]
     # Query block t covers absolute positions [start + t*TB, start + t*TB +
     # TB); key block s is (partially) visible iff its first key position is
-    # <= the block's last query position.
-    last_q_pos = start + t * block_t + (block_t - 1)
+    # <= the block's last query position (and, with a sliding window, its
+    # last key position within `window` of the block's FIRST query).
+    first_q_pos = start + t * block_t
+    last_q_pos = first_q_pos + (block_t - 1)
+    live = s * block_s <= last_q_pos
+    if window:
+        live = live & ((s + 1) * block_s - 1 > first_q_pos - window)
 
-    @pl.when(s * block_s <= last_q_pos)
+    @pl.when(live)
     def _block():
         def mask(scores):
             q_pos = start + t * block_t + jax.lax.broadcasted_iota(
                 jnp.int32, scores.shape, 0)
             s_pos = s * block_s + jax.lax.broadcasted_iota(
                 jnp.int32, scores.shape, 1)
-            return jnp.where(s_pos <= q_pos, scores, NEG_INF)
+            ok = s_pos <= q_pos
+            if window:
+                ok = ok & (s_pos > q_pos - window)
+            return jnp.where(ok, scores, NEG_INF)
         attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask,
                      ks_ref, vs_ref)
 
@@ -264,13 +296,16 @@ def _prefill_kernel(start_ref, q_ref, *refs, block_t: int, block_s: int):
 def flash_prefill_attention(q: jax.Array, layer_k, layer_v,
                             start: jax.Array,
                             *, block_t: int = 128, block_s: int = 128,
+                            window: int = 0,
                             interpret: bool | None = None) -> jax.Array:
     """Causal chunk attention over an (already updated) cache.
 
     q: [B, T, H, Dh] — the chunk's queries at absolute positions
     ``start + t``; layer_k/v: [B, KV, S, Dh] (head-major) with the chunk's
     keys already inserted at ``[start, start+T)``, or the int8 ``{"q","s"}``
-    dicts (kv_quant layout); start: [B] int32.
+    dicts (kv_quant layout); start: [B] int32. ``window``: sliding-window
+    bound (0 = full causal) — out-of-window key blocks skip compute and
+    their DMA is elided.
     Returns [B, T, H * Dh] in q.dtype.
     """
     B, T, H, Dh = q.shape
@@ -286,16 +321,27 @@ def flash_prefill_attention(q: jax.Array, layer_k, layer_v,
     qh = q.transpose(0, 2, 1, 3)                 # [B, H, T, Dh]
     grid = (B, H, T // block_t, S // block_s)
 
+    def _live_range(st_b, t):
+        # Clamp to the causally-visible (and in-window) key-block range
+        # for query block t — out-of-range iterations repeat a live block
+        # index so their HBM→VMEM copy is elided (compute already skipped
+        # by pl.when).
+        last = (st_b + t * block_t + (block_t - 1)) // block_s
+        if window:
+            first_q = st_b + t * block_t
+            first = jnp.maximum(first_q - (window - 1), 0) // block_s
+            first = jnp.minimum(first, last)
+        else:
+            first = 0
+        return first, last
+
     def kv_index(b, h, t, s, st):
-        # Clamp to the last causally-visible key block for query block t —
-        # invisible iterations repeat the previous block index so their
-        # HBM→VMEM copy is elided (compute already skipped by pl.when).
-        last_q_pos = st[b] + t * block_t + (block_t - 1)
-        return b, h // G, jnp.minimum(s, last_q_pos // block_s), 0
+        first, last = _live_range(st[b], t)
+        return b, h // G, jnp.clip(s, first, last), 0
 
     def scale_index(b, h, t, s, st):
-        last_q_pos = st[b] + t * block_t + (block_t - 1)
-        return b, h // G, jnp.minimum(s, last_q_pos // block_s)
+        first, last = _live_range(st[b], t)
+        return b, h // G, jnp.clip(s, first, last)
 
     kv_spec = pl.BlockSpec((1, 1, block_s, Dh), kv_index)
     s_spec = pl.BlockSpec((1, 1, block_s), scale_index)
@@ -307,7 +353,8 @@ def flash_prefill_attention(q: jax.Array, layer_k, layer_v,
         kv_specs = [kv_spec, kv_spec]
 
     out = pl.pallas_call(
-        functools.partial(_prefill_kernel, block_t=block_t, block_s=block_s),
+        functools.partial(_prefill_kernel, block_t=block_t, block_s=block_s,
+                          window=window),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
@@ -346,7 +393,8 @@ def _auto_block(n: int, cap: int) -> int:
 
 def make_cache_attention_fn(block_s: int | None = None,
                             block_t: int | None = None,
-                            interpret: bool | None = None):
+                            interpret: bool | None = None,
+                            window: int = 0):
     """Build an ``attention_fn`` (llama.py forward contract) backed by the
     flash kernels. Prefill chunks (T>1): insert in XLA, attend with the
     causal kernel. Decode (T==1): the deferred protocol — ``.decode``
@@ -365,7 +413,7 @@ def make_cache_attention_fn(block_s: int | None = None,
         bt = block_t if block_t is not None else _auto_block(T, 128)
         out = flash_prefill_attention(
             q, layer_k, layer_v, lengths,
-            block_t=bt, block_s=bs, interpret=interpret)
+            block_t=bt, block_s=bs, window=window, interpret=interpret)
         return out, layer_k, layer_v
 
     def decode(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
@@ -380,7 +428,7 @@ def make_cache_attention_fn(block_s: int | None = None,
         n_stale = lengths if active is None else jnp.where(active, lengths, 0)
         out = flash_decode_attention(
             q[:, 0], k_new[:, 0], v_new[:, 0], layer_k, layer_v,
-            n_stale, block_s=bs, interpret=interpret)
+            n_stale, block_s=bs, window=window, interpret=interpret)
         return out[:, None, :]
 
     from ..models.llama import insert_kv_stacked

@@ -98,10 +98,10 @@ def test_hf_logit_parity_with_sliding_window(tmp_path):
 
 
 async def _serve(mesh, devs, **kw):
+    kw.setdefault("attention", "reference")
     cfg = LocalEngineConfig(preset="tiny-mistral-test", max_batch_size=2,
                             max_seq_len=128, prefill_chunk=32,
                             dtype="float32", decode_burst=4,
-                            attention="reference",
                             prewarm_sampler_variants=False,
                             compilation_cache_dir="off", **kw)
     eng = InferenceEngine(cfg, devices=devs)
@@ -131,6 +131,20 @@ async def test_engine_swa_composes_with_pp_and_spec():
     spec, eng = await _serve({}, [cpu_devices()[0]], spec_draft_len=3)
     assert spec.generated == ref.generated
     assert eng._spec_steps_done > 0          # speculation really engaged
+
+
+async def test_engine_swa_pallas_matches_reference():
+    """Single-device SWA engines run the WINDOWED flash kernels
+    (interpret mode on CPU) — greedy tokens must match the windowed
+    dense reference engine exactly."""
+    ref, _ = await _serve({}, [cpu_devices()[0]])
+    pal, eng = await _serve({}, [cpu_devices()[0]], attention="pallas")
+    assert pal.generated == ref.generated
+    assert eng.model_cfg.sliding_window == 16
+    # The flash path really engaged (a silent downgrade to reference
+    # would make this test compare the reference to itself).
+    assert eng._resolve_attention_impl() == "pallas"
+    assert eng._pick_attention() is not None
 
 
 def test_swa_guardrails():

@@ -86,6 +86,68 @@ def test_prefill_kernel_matches_reference(B, S, T, H, KV, Dh, start_max,
     np.testing.assert_allclose(np.asarray(got_v), np.asarray(ref_v))
 
 
+@pytest.mark.parametrize("W,block_s", [
+    (8, 16),     # window smaller than a block
+    (24, 16),    # window spanning blocks, unaligned
+    (16, 32),    # window half a block
+])
+def test_decode_kernel_sliding_window(W, block_s):
+    """Windowed decode kernel (mistral family) vs the windowed dense
+    reference — the leading out-of-window blocks must be masked AND
+    dma-elided without changing the math."""
+    B, S, H, KV, Dh = 3, 64, 4, 2, 16
+    q, k_new, v_new, layer_k, layer_v = _mk(B, S, 1, H, KV, Dh, seed=3)
+    from llmapigateway_tpu.models.llama import dense_decode_attention
+    lengths = jnp.asarray([0, 29, 61], jnp.int32)   # fresh / mid / near-full
+    ref = dense_decode_attention(q, k_new, v_new, layer_k, layer_v,
+                                 lengths, window=W)
+    got = flash_decode_attention(
+        q[:, 0], k_new[:, 0], v_new[:, 0], layer_k, layer_v, lengths,
+        block_s=block_s, window=W, interpret=True)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(ref).reshape(B, H * Dh),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("W,bt,bs", [(8, 8, 16), (20, 16, 16)])
+def test_prefill_kernel_sliding_window(W, bt, bs):
+    """Windowed chunk kernel vs the windowed dense reference, with the
+    chunk crossing the window boundary mid-block."""
+    B, S, T, H, KV, Dh = 2, 128, 32, 4, 2, 16
+    q, k_new, v_new, layer_k, layer_v = _mk(B, S, T, H, KV, Dh, seed=4)
+    start = jnp.asarray([0, 57], jnp.int32)
+    ref, ref_k, ref_v = dense_cache_attention(
+        q, k_new, v_new, layer_k, layer_v, start, window=W)
+    attn = make_cache_attention_fn(block_s=bs, block_t=bt, interpret=True,
+                                   window=W)
+    got, got_k, got_v = attn(q, k_new, v_new, layer_k, layer_v, start)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(got_k), np.asarray(ref_k))
+    np.testing.assert_allclose(np.asarray(got_v), np.asarray(ref_v))
+
+
+def test_decode_kernel_sliding_window_int8_cache():
+    """Windowed decode over the int8 {q,s} cache: the scale blocks ride
+    the same first/last DMA clamp as the values."""
+    from llmapigateway_tpu.models.llama import (KVCache, quantize_kv,
+                                                dense_decode_attention)
+    B, S, H, KV, Dh, W = 3, 64, 4, 2, 16, 12
+    q, k_new, v_new, layer_k, layer_v = _mk(B, S, 1, H, KV, Dh, seed=5)
+    kq, ks = quantize_kv(layer_k)
+    vq, vs = quantize_kv(layer_v)
+    qk = {"q": kq, "s": ks}
+    qv = {"q": vq, "s": vs}
+    lengths = jnp.asarray([0, 23, 61], jnp.int32)
+    ref = dense_decode_attention(q, k_new, v_new, qk, qv, lengths, window=W)
+    got = flash_decode_attention(
+        q[:, 0], k_new[:, 0], v_new[:, 0], qk, qv, lengths,
+        block_s=16, window=W, interpret=True)
+    np.testing.assert_allclose(np.asarray(got),
+                               np.asarray(ref).reshape(B, H * Dh),
+                               rtol=2e-4, atol=2e-4)
+
+
 def test_full_forward_flash_vs_dense():
     """Whole-model check: llama.forward with the flash attention_fn matches
     the dense jnp path bit-for-tolerance on both prefill and decode."""
