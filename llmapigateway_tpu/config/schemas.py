@@ -58,7 +58,8 @@ class LocalEngineConfig(BaseModel):
     # the normal (unaccelerated) decode path. Works with both KV
     # layouts and composes with seq/pipe sharding (the verify forward's
     # S-reductions partition under GSPMD / run through the staged
-    # block); single-process only, and not with kv_quant (exact-greedy
+    # block) AND with multi-host serving (OP_SPEC command stream,
+    # per-process hist mirrors). Not with kv_quant (exact-greedy
     # guarantee).
     spec_draft_len: int = 0
     # Adaptive drafting gate: a speculative step is a T=k+1 verify forward

@@ -287,10 +287,11 @@ class InferenceEngine:
                 raise ValueError(
                     f"spec_draft_len must be one of 1, 3, 7 (verify width "
                     f"k+1 must be a power of two), got {self.spec_k}")
-            if self._bridge.enabled:
-                raise ValueError("speculative decoding is single-process "
-                                 "only (v1): the multihost command stream "
-                                 "carries fixed step counts")
+            # Multihost composes: OP_SPEC rides the command stream, every
+            # process maintains a bit-identical hist mirror, and the
+            # data-dependent advances are derived on each host from its
+            # own fetch of the same emitted matrix (parallel/multihost.py
+            # wire-format notes).
 
         self.tokenizer = load_tokenizer(
             engine_cfg.tokenizer_path or engine_cfg.model_path or None,
@@ -1105,6 +1106,7 @@ class InferenceEngine:
         chunk = np.asarray(ids[pos:pos + self.prefill_chunk], np.int32)
         if self.fault_plan:
             self.fault_plan.on_prefill()
+        self._spec_hist_chunk(slot, pos, chunk)
         self._bridge.publish_prefill(slot, pos, chunk,
                                      table=self._table_to_publish())
         self._rng, key = jax.random.split(self._rng)
@@ -1125,12 +1127,11 @@ class InferenceEngine:
         req.t_first_token = time.monotonic()
         self.lengths[slot] = len(ids)
         self.last_token[slot] = first_id
-        if self.spec_k:
-            # Token history for prompt-lookup drafting: prompt at [0, P);
-            # the first generated token is the input at P, written by the
-            # spec step that consumes it (see _spec_burst's walk).
-            self.hist[slot, :len(ids)] = ids
-            self.hist[slot, len(ids):] = 0
+        # (Token history for prompt-lookup drafting is maintained per
+        # CHUNK in _prefill_one_chunk — identically on multihost
+        # followers, so every process's hist mirror stays bit-identical
+        # at all times; the first generated token is the input at P,
+        # written by the spec step that consumes it.)
         self.active[slot] = True
         self.samp_temperature[slot] = req.temperature
         self.samp_top_p[slot] = req.top_p
@@ -1231,21 +1232,76 @@ class InferenceEngine:
             self.allocator.table[:, :] = table
             self._table_dirty = True
 
+    def _spec_hist_chunk(self, slot: int, pos: int,
+                         chunk: np.ndarray) -> None:
+        """Per-chunk token-history maintenance for prompt-lookup drafting
+        — the ONE copy, run identically on the coordinator (from the
+        scheduler) and on followers (from the replay loop), so every
+        process's hist mirror is bit-identical at every moment (a spec
+        upload may happen while another slot is mid-prefill)."""
+        if not self.spec_k:
+            return
+        if pos == 0:
+            self.hist[slot, :] = 0
+        self.hist[slot, pos:pos + len(chunk)] = chunk
+
     def _follow_prefill(self, slot: int, pos: int, chunk: np.ndarray,
                         table: np.ndarray | None = None) -> None:
         self._apply_table(table)
+        self._spec_hist_chunk(slot, pos, chunk)
         _, self.cache = self._exec_prefill(slot, pos, chunk)
 
     def _follow_decode(self, n_steps: int, state: dict,
                        table: np.ndarray | None = None) -> None:
         self._apply_table(table)
-        self._exec_decode(n_steps, state)
+        self.lengths[:] = state["lengths"]
+        self.active[:] = state["active"]
+        self.last_token[:] = state["last_token"]
+        step_tokens = self._exec_decode(n_steps, state)
+        # Same mirror advance as the coordinator (incl. the spec hist) so
+        # a later spec reupload sees bit-identical host state.
+        self._advance_after_decode(n_steps, step_tokens)
+
+    def _advance_after_decode(self, n_steps: int,
+                              step_tokens: list[np.ndarray]) -> None:
+        """Shared multihost post-decode mirror advance: lengths,
+        last_token, and — on speculative engines — the prompt-lookup
+        history (otherwise a mixed-mode engine's hist would silently go
+        stale and a later spec reupload would diverge from the device
+        chain)."""
+        for slot in np.nonzero(self.active)[0]:
+            if self.spec_k:
+                L = int(self.lengths[slot])
+                if L < self.S:
+                    self.hist[slot, L] = int(self.last_token[slot])
+                m = min(n_steps, self.S - (L + 1))
+                for t in range(m):
+                    self.hist[slot, L + 1 + t] = int(step_tokens[t][slot])
+            self.last_token[slot] = int(step_tokens[-1][slot])
+        self.lengths[self.active] += n_steps
+        if self.spec_k:
+            self._d_hist_fresh = False
+
+    def _follow_spec(self, n_steps: int, reupload: bool, state: dict,
+                     table: np.ndarray | None = None) -> None:
+        """Replay one speculative burst: sync host mirrors from the
+        command state, execute the identical program (rebuilding device
+        mirrors from the local hist on a reupload), and walk the fetched
+        emitted matrix so lengths/last_token/hist advance exactly as on
+        the coordinator."""
+        self._apply_table(table)
+        self.lengths[:] = state["lengths"]
+        self.active[:] = state["active"]
+        self.last_token[:] = state["last_token"]
+        host = self._exec_spec(n_steps, state if reupload else None)
+        self._spec_walk(host, self.active.copy(), self.active.copy())
 
     def run_follower(self) -> None:
         """Blocking replay loop for follower processes (process_index > 0)
         of a multi-host deployment: execute every compiled call the
         coordinator publishes, until shutdown."""
-        self._bridge.follow(self._follow_prefill, self._follow_decode)
+        self._bridge.follow(self._follow_prefill, self._follow_decode,
+                            self._follow_spec if self.spec_k else None)
 
     def _spec_burst(self, n_steps: int) -> list[np.ndarray]:
         """Run `n_steps` speculative draft+verify steps (engine/
@@ -1262,6 +1318,28 @@ class InferenceEngine:
         handles raggedness)."""
         if self.fault_plan:
             self.fault_plan.on_decode()
+        if self._bridge.enabled:
+            # Multihost: synchronous per burst (like the decode path) —
+            # publish the command, run the identical program on every
+            # process, and walk the fetched emitted matrix so all hosts'
+            # mirrors stay bit-identical. The hist never rides the wire:
+            # every process maintains its own mirror (see
+            # _spec_hist_chunk / _spec_walk); a reupload rebuilds the
+            # device hist from it on both sides.
+            reupload = self._d_dirty or not self._d_hist_fresh
+            self._rng, key = jax.random.split(self._rng)
+            packed = self._bridge.pack_decode_state(
+                self.lengths, self.active, self.last_token,
+                self.samp_top_k, self.samp_temperature, self.samp_top_p,
+                np.asarray(jax.random.key_data(key)))
+            self._bridge.publish_spec(n_steps, reupload, packed,
+                                      table=self._table_to_publish())
+            state = self._bridge.unpack_decode_state(packed)
+            host = self._exec_spec(n_steps, state if reupload else None)
+            self._d_dirty = False
+            self._d_hist_fresh = True
+            return self._spec_walk(host, self.active.copy(),
+                                   self.active.copy())
         # A mixed-mode engine may have a normal burst in flight (the batch
         # just turned all-greedy): land it first so mirrors are exact.
         pre = self._flush_pending()
@@ -1269,20 +1347,7 @@ class InferenceEngine:
             # Upload needs exact host mirrors — land any in-flight spec
             # burst before reading them.
             pre += self._flush_spec_pending()
-            rep = NamedSharding(self.mesh, P())
-            self._d_tokens = jax.device_put(self.last_token, rep)
-            self._d_lengths = jax.device_put(self.lengths, rep)
-            self._d_active = jax.device_put(self.active, rep)
-            self._d_hist = jax.device_put(self.hist, rep)
-            # Sampler mirrors too: this branch clears _d_dirty, and a later
-            # spec→normal mode switch (e.g. the cache-end fallback) must
-            # not hand _decode_burst a never-built _d_samp — a None there
-            # retraces the decode program with a different pytree structure
-            # (full XLA compile mid-serving).
-            self._d_samp = SamplingParams(
-                temperature=jax.device_put(self.samp_temperature, rep),
-                top_p=jax.device_put(self.samp_top_p, rep),
-                top_k=jax.device_put(self.samp_top_k, rep))
+            self._spec_upload()
             self._d_dirty = False
             self._d_hist_fresh = True
 
@@ -1317,6 +1382,60 @@ class InferenceEngine:
             outs.append(em)
         host = np.stack([np.asarray(e) for e in outs])
         return pre + self._spec_walk(host, self.active, self.active.copy())
+
+    def _spec_upload(self, state: dict | None = None) -> None:
+        """Rebuild EVERY device mirror for the speculative chain — the ONE
+        copy for the single-process path (from the engine's own host
+        mirrors) and the multihost path (from the broadcast slot state;
+        the hist always comes from the LOCAL bit-identical mirror).
+        Includes the sampler mirrors: a later spec→normal mode switch
+        (e.g. the cache-end fallback) must not hand _decode_burst a
+        never-built _d_samp — a None there retraces the decode program
+        with a different pytree structure (full XLA compile
+        mid-serving)."""
+        rep = NamedSharding(self.mesh, P())
+        s = state or {}
+        self._d_tokens = jax.device_put(
+            np.asarray(s.get("last_token", self.last_token), np.int32), rep)
+        self._d_lengths = jax.device_put(
+            np.asarray(s.get("lengths", self.lengths), np.int32), rep)
+        self._d_active = jax.device_put(
+            np.asarray(s.get("active", self.active), bool), rep)
+        self._d_hist = jax.device_put(self.hist, rep)
+        self._d_samp = SamplingParams(
+            temperature=jax.device_put(np.asarray(
+                s.get("temperature", self.samp_temperature), np.float32),
+                rep),
+            top_p=jax.device_put(np.asarray(
+                s.get("top_p", self.samp_top_p), np.float32), rep),
+            top_k=jax.device_put(np.asarray(
+                s.get("top_k", self.samp_top_k), np.int32), rep))
+
+    def _exec_spec(self, n_steps: int, state: dict | None) -> np.ndarray:
+        """The one compiled-speculative-burst call — identical on
+        coordinator and followers. ``state`` non-None = reupload: rebuild
+        every device mirror (incl. the hist, from the LOCAL bit-identical
+        host mirror) from the broadcast slot state; None = chain the
+        device arrays from the previous burst. Returns the fetched
+        emitted matrix [n_steps, B, k+1] (synchronous — multihost has no
+        lag-one)."""
+        if state is not None:
+            self._spec_upload(state)
+        table = (self._device_table(),) if self.paged else ()
+        if n_steps == self._spec_scan_len:
+            emitted, self.cache, self._d_hist, self._d_tokens, \
+                self._d_lengths = self._spec_scan(
+                    self.params, self.cache, *table, self._d_hist,
+                    self._d_tokens, self._d_lengths, self._d_active)
+            return np.asarray(emitted)
+        outs = []
+        for _ in range(n_steps):
+            self._d_tokens, self._d_lengths, self.cache, self._d_hist, \
+                em, _ = self._spec_step(
+                    self.params, self.cache, *table, self._d_hist,
+                    self._d_tokens, self._d_lengths, self._d_active)
+            outs.append(em)
+        return np.stack([np.asarray(e) for e in outs])
 
     def _spec_inflight_advance(self) -> int:
         """Upper bound on cache positions an in-flight speculative burst
@@ -1449,9 +1568,7 @@ class InferenceEngine:
                                         table=self._table_to_publish())
             step_tokens = self._exec_decode(
                 n_steps, self._bridge.unpack_decode_state(packed))
-            self.lengths[self.active] += n_steps
-            for slot in np.nonzero(self.active)[0]:
-                self.last_token[slot] = int(step_tokens[-1][slot])
+            self._advance_after_decode(n_steps, step_tokens)
             return step_tokens
 
         pre: list[np.ndarray] = []

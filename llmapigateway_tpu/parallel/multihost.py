@@ -28,6 +28,12 @@ Wire format: ONE int32 vector per command, shape ``[HEADER + payload]``
     temperature[B], top_p[B] (float32 bit-cast) then rng key (uint32
     bit-cast) — everything a follower needs to build bit-identical
     decode inputs.
+  * SPEC_BURST:     opcode 4, a=n_steps, b=reupload flag, payload = the
+    same packed state. The token HISTORY is never on the wire: every
+    process maintains a bit-identical host hist mirror (prefill chunks
+    write it; each spec burst's fetched emitted matrix advances it via
+    the same walk), so on a reupload both sides rebuild the device hist
+    from their own mirrors.
   * ``cmd[3]`` is RESERVED as the has-table flag: when 1, the LAST
     ``B * table_slots`` ints of the frame carry the paged-KV page table
     (followers have no allocator; table changes ride the same stream
@@ -56,6 +62,7 @@ OP_SHUTDOWN = 0
 OP_PREFILL = 1
 OP_DECODE = 2
 OP_PREFILL_PART = 3
+OP_SPEC = 4
 
 # Token capacity cap per frame: keeps the FIXED frame width small even when
 # the prefill bucket is the whole max_seq_len (seq-parallel engines) — a
@@ -224,6 +231,14 @@ class HostBridge:
         self._broadcast(self._frame(OP_DECODE, n_steps, payload=state,
                                     table=table))
 
+    def publish_spec(self, n_steps: int, reupload: bool, state: np.ndarray,
+                     table: np.ndarray | None = None) -> None:
+        if not self.enabled:
+            return
+        self._check_live()
+        self._broadcast(self._frame(OP_SPEC, n_steps, int(reupload),
+                                    payload=state, table=table))
+
     def publish_shutdown(self) -> None:
         """Idempotent: a second broadcast after followers have exited their
         replay loop would block forever in the collective."""
@@ -234,7 +249,8 @@ class HostBridge:
 
     # -- follower side --------------------------------------------------------
     def follow(self, on_prefill: Callable[..., None],
-               on_decode: Callable[..., None]) -> None:
+               on_decode: Callable[..., None],
+               on_spec: Callable[..., None] | None = None) -> None:
         """Blocking replay loop for follower processes (process_index > 0):
         receive one command, execute the same compiled call, repeat until
         SHUTDOWN. Callbacks receive the attached page table (or None) as
@@ -261,5 +277,12 @@ class HostBridge:
             elif op == OP_DECODE:
                 on_decode(int(cmd[1]), self.unpack_decode_state(payload),
                           table)
+            elif op == OP_SPEC:
+                if on_spec is None:
+                    raise RuntimeError(
+                        "SPEC command on a non-speculative follower "
+                        "(spec_draft_len mismatch across processes?)")
+                on_spec(int(cmd[1]), bool(cmd[2]),
+                        self.unpack_decode_state(payload), table)
             else:
                 raise RuntimeError(f"unknown multihost opcode {op}")

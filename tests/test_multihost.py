@@ -25,14 +25,20 @@ def _free_port() -> str:
 import pytest
 
 
-@pytest.mark.parametrize("kv_layout,quant", [
-    ("contiguous", ""), ("paged", ""),
+@pytest.mark.parametrize("kv_layout,quant,spec", [
+    ("contiguous", "", 0), ("paged", "", 0),
     # Fully-int8 lockstep: the jitted sharded param init must be
     # deterministic across processes (same program + key → identical
     # int8 weights), and the quantized decode must stay bit-identical.
-    ("contiguous", "int8"),
+    ("contiguous", "int8", 0),
+    # Speculative lockstep: OP_SPEC commands, per-process hist mirrors,
+    # and DATA-DEPENDENT advances derived on each host from its own
+    # fetch of the same emitted matrix — over both KV layouts (paged
+    # additionally exercises the page-table tail on OP_SPEC frames).
+    ("contiguous", "", 3),
+    ("paged", "", 3),
 ])
-def test_two_process_lockstep_serving(kv_layout, quant):
+def test_two_process_lockstep_serving(kv_layout, quant, spec):
     env = {**os.environ,
            "JAX_PLATFORMS": "cpu",
            "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
@@ -40,7 +46,7 @@ def test_two_process_lockstep_serving(kv_layout, quant):
     port = _free_port()
     procs = [subprocess.Popen(
         [sys.executable, str(ROOT / "tests" / "multihost_worker.py"),
-         str(i), "2", port, kv_layout, quant],
+         str(i), "2", port, kv_layout, quant, str(spec)],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
         text=True) for i in range(2)]
     outs = []
