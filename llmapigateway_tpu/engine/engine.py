@@ -186,10 +186,17 @@ class InferenceEngine:
         self.seq_attention = engine_cfg.seq_attention
         if self.seq_n > 1:
             if self.paged:
-                raise ValueError(
-                    "sequence parallelism requires kv_layout=contiguous "
-                    "(the paged pool is indexed by a replicated page table; "
-                    "sharding pages over `seq` is not supported)")
+                # Paged × seq: the pool's page dim shards over `seq` with
+                # POSITION-BANDED allocation (engine/paged.py) so every
+                # chip's S-shard of the gathered dense view reads only
+                # local pages; band boundaries must fall on pages.
+                if self.S % (self.seq_n * self.cfg.kv_page_size):
+                    raise ValueError(
+                        f"paged × seq needs max_seq_len {self.S} divisible "
+                        f"by seq × page = "
+                        f"{self.seq_n * self.cfg.kv_page_size}")
+                # (SWA × paged and SWA × seq are each rejected by the
+                # sliding-window guardrails below.)
             if self.S % self.seq_n:
                 raise ValueError(
                     f"max_seq_len {self.S} must be divisible by the seq "
@@ -400,15 +407,21 @@ class InferenceEngine:
 
             page = self.cfg.kv_page_size
             per_slot = (self.S + page - 1) // page
-            num_pages = self.cfg.kv_num_pages or (self.B * per_slot + 1)
-            if num_pages - 1 < per_slot:
+            n_bands = self.seq_n if self.seq_n > 1 else 1
+            # One trash page per band (seq-sharded pools redirect masked
+            # writes shard-locally).
+            num_pages = self.cfg.kv_num_pages or (
+                self.B * per_slot + n_bands)
+            if num_pages - n_bands < per_slot:
                 raise ValueError(
                     f"kv_num_pages={num_pages} cannot hold one max-length "
                     f"sequence ({per_slot} pages of {page})")
-            self.allocator = PageAllocator(num_pages, page, self.B, self.S)
+            self.allocator = PageAllocator(num_pages, page, self.B, self.S,
+                                           n_bands=n_bands)
             psh = paged_cache_sharding(
                 self.mesh, c.n_kv_heads,
-                n_layers=c.n_layers if self.pipe_n > 1 else None)
+                n_layers=c.n_layers if self.pipe_n > 1 else None,
+                num_pages=num_pages if n_bands > 1 else None)
             shape = (c.n_layers, num_pages, c.n_kv_heads, page, c.head_dim)
             # Layout owned by PagedKVCache.create (the one copy of the
             # int8 {q,s} scheme); 5-D value leaves shard via psh, the 4-D
@@ -678,12 +691,33 @@ class InferenceEngine:
                                                  make_attention=make_attn)
 
             def call_forward(params, cache, table, tokens, lengths,
-                             active=None):
+                             active=None, prefill=False):
                 return pipe_fwd(params, c, tokens, lengths, cache,
                                 active=active, table=table)
+        elif self.seq_n > 1:
+            # Paged × seq: whole-prompt prefill attends via ring/ulysses
+            # over the fresh q/k/v (no cache read) and writes through the
+            # shard_map'd BANDED scatter; decode gathers each chip's
+            # local pages into the dense S-sharded view and runs the
+            # dict-aware deferred dense attention under GSPMD — the same
+            # partitioning story as the dense seq engine
+            # (ops/paged_attention.make_seq_paged_attention_fn).
+            from ..ops.paged_attention import make_seq_paged_attention_fn
+            seq_kind = self.seq_attention
+            eng_mesh = self.mesh
+
+            def call_forward(params, cache, table, tokens, lengths,
+                             active=None, prefill=False):
+                attn = make_seq_paged_attention_fn(table, max_seq=S,
+                                                   mesh=eng_mesh)
+                if prefill:
+                    attn = _seq_paged_prefill_attention_fn(
+                        eng_mesh, seq_kind, attn)
+                return family_forward(params, c, tokens, lengths, cache,
+                                      active=active, attention_fn=attn)
         else:
             def call_forward(params, cache, table, tokens, lengths,
-                             active=None):
+                             active=None, prefill=False):
                 attn = make_paged_attention_fn(table, max_seq=S, impl=impl,
                                                mesh=mesh)
                 return family_forward(params, c, tokens, lengths, cache,
@@ -702,7 +736,7 @@ class InferenceEngine:
             sampled token, cache) — sampling folded in, see dense twin."""
             row = jax.lax.dynamic_slice_in_dim(table, slot, 1, axis=0)
             logits, cache = call_forward(params, cache, row, tokens,
-                                         start_len[None])
+                                         start_len[None], prefill=True)
             out = jax.lax.with_sharding_constraint(
                 jax.lax.dynamic_index_in_dim(logits[0], last_idx, 0,
                                              keepdims=False), replicated)
@@ -1772,7 +1806,8 @@ class InferenceEngine:
             out["kv_quant"] = self.kv_quant
         if self.paged:
             out["free_pages"] = self.allocator.free_pages
-            out["total_pages"] = self.allocator.num_pages - 1
+            out["total_pages"] = (self.allocator.num_pages
+                                  - self.allocator.n_bands)
             out["page_size"] = self.allocator.page_size
         if self._ema_step_ms is not None:
             out["decode_ms_per_step"] = round(self._ema_step_ms, 3)
@@ -1846,6 +1881,27 @@ def _spec_verify_attention_fn(base, window: int = 0):
     attn.decode = getattr(base, "decode", llama.dense_decode_attention)
     attn.insert_all = getattr(base, "insert_all", llama.insert_kv_stacked)
     return attn
+
+
+def _seq_paged_prefill_attention_fn(mesh, kind, base):
+    """Whole-prompt prefill for the PAGED seq engine: same ring/ulysses
+    collective attention as the dense twin below (prefill starts at
+    position 0, so the chunk is the full visible context — no cache
+    read), but writes land through the seq-paged provider's shard_map'd
+    banded scatter (``base.insert``)."""
+    from ..parallel.ring_attention import ring_attention
+    from ..parallel.ulysses import ulysses_attention
+
+    op = ring_attention if kind == "ring" else ulysses_attention
+
+    def attention_fn(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
+        B, T, H, Dh = q.shape
+        attn = op(q, k_new, v_new, mesh, axis="seq", causal=True)
+        layer_k, layer_v = base.insert(layer_k, layer_v, k_new, v_new,
+                                       lengths, active)
+        return attn.reshape(B, T, H * Dh), layer_k, layer_v
+
+    return attention_fn
 
 
 def _seq_prefill_attention_fn(mesh, kind: str = "ring"):

@@ -19,39 +19,75 @@ import numpy as np
 
 
 class PageAllocator:
+    """``n_bands > 1`` = SEQUENCE-BANDED allocation (paged × seq
+    sharding): the pool's page dim is sharded over the ``seq`` mesh axis
+    into ``n_bands`` equal shards, and a slot's logical page ``j``
+    (covering positions ``[j·page, (j+1)·page)``) must be a PHYSICAL page
+    owned by the shard whose position band contains it — so every chip's
+    S-shard of the gathered dense view reads only LOCAL pages. The first
+    physical page of EVERY band is that chip's trash page (masked scatter
+    redirect must stay shard-local) and is never allocated."""
+
     def __init__(self, num_pages: int, page_size: int, batch: int,
-                 max_seq: int):
-        if num_pages < 2:
-            raise ValueError("need at least 2 pages (page 0 is reserved)")
+                 max_seq: int, n_bands: int = 1):
+        if num_pages < 2 * n_bands:
+            raise ValueError(f"need at least {2 * n_bands} pages "
+                             f"({n_bands} band trash pages reserved)")
+        if num_pages % n_bands:
+            raise ValueError(f"num_pages {num_pages} not divisible by "
+                             f"{n_bands} bands")
+        if n_bands > 1 and max_seq % (n_bands * page_size):
+            # Single-band pools keep the legacy ceil-division tolerance
+            # for non-page-aligned max_seq; banding needs exact alignment.
+            raise ValueError(
+                f"max_seq {max_seq} must be a multiple of n_bands × "
+                f"page_size = {n_bands * page_size} (band boundaries must "
+                f"fall on page boundaries)")
         self.page_size = page_size
         self.num_pages = num_pages
+        self.n_bands = n_bands
+        self.band_pages = num_pages // n_bands      # physical pages per band
         self.pages_per_slot = (max_seq + page_size - 1) // page_size
-        # Free list excludes trash page 0. LIFO: recently-freed pages are
-        # likely still warm in cache-coherence terms.
-        self._free: list[int] = list(range(num_pages - 1, 0, -1))
-        # [B, NP] physical page per (slot, logical page); 0 = unallocated.
+        self.slot_band_pages = self.pages_per_slot // n_bands
+        # Per-band free lists, excluding each band's trash page (its first
+        # physical id). LIFO: recently-freed pages are likely still warm.
+        self._free: list[list[int]] = [
+            list(range((b + 1) * self.band_pages - 1,
+                       b * self.band_pages, -1))
+            for b in range(n_bands)]
+        # [B, NP] physical page per (slot, logical page); 0 = unallocated
+        # (0 is band 0's trash page, never a real mapping).
         self.table = np.zeros((batch, self.pages_per_slot), np.int32)
         self._held: dict[int, list[int]] = {}
 
     @property
     def free_pages(self) -> int:
-        return len(self._free)
+        return sum(len(f) for f in self._free)
+
+    def _band_of(self, logical_page: int) -> int:
+        return logical_page // self.slot_band_pages
 
     def pages_needed(self, total_tokens: int) -> int:
         return (min(total_tokens, self.pages_per_slot * self.page_size)
                 + self.page_size - 1) // self.page_size
 
     def can_admit(self, total_tokens: int) -> bool:
-        return self.pages_needed(total_tokens) <= len(self._free)
+        need = self.pages_needed(total_tokens)
+        if self.n_bands == 1:
+            return need <= len(self._free[0])
+        return all(
+            sum(1 for j in range(need) if self._band_of(j) == b)
+            <= len(self._free[b])
+            for b in range(self.n_bands))
 
     def allocate(self, slot: int, total_tokens: int) -> bool:
         """Reserve all pages for a slot's lifetime. False if insufficient."""
         if slot in self._held:
             raise ValueError(f"slot {slot} already holds pages")
         need = self.pages_needed(total_tokens)
-        if need > len(self._free):
+        if not self.can_admit(total_tokens):
             return False
-        pages = [self._free.pop() for _ in range(need)]
+        pages = [self._free[self._band_of(j)].pop() for j in range(need)]
         self._held[slot] = pages
         self.table[slot, :] = 0
         self.table[slot, :need] = pages
@@ -60,18 +96,26 @@ class PageAllocator:
     def release(self, slot: int) -> None:
         pages = self._held.pop(slot, None)
         if pages:
-            self._free.extend(pages)
+            for j, p in enumerate(pages):
+                self._free[self._band_of(j)].append(p)
         self.table[slot, :] = 0
 
     def check_invariants(self) -> None:
         """Test hook: every non-trash page is either free or held by exactly
-        one slot; table rows agree with holdings."""
+        one slot; table rows agree with holdings; banded pages stay in
+        their position band."""
         held = [p for pages in self._held.values() for p in pages]
+        free = [p for f in self._free for p in f]
+        trash = {b * self.band_pages for b in range(self.n_bands)}
         assert len(held) == len(set(held)), "page double-held"
-        assert not (set(held) & set(self._free)), "page both free and held"
-        assert 0 not in held and 0 not in self._free, "trash page leaked"
-        assert len(held) + len(self._free) == self.num_pages - 1, "page lost"
+        assert not (set(held) & set(free)), "page both free and held"
+        assert not (trash & set(held + free)), "trash page leaked"
+        assert len(held) + len(free) == self.num_pages - self.n_bands, \
+            "page lost"
         for slot, pages in self._held.items():
             row = self.table[slot]
             assert list(row[:len(pages)]) == pages, "table/holding mismatch"
             assert (row[len(pages):] == 0).all()
+            for j, p in enumerate(pages):
+                assert p // self.band_pages == self._band_of(j), \
+                    f"page {p} outside its position band"

@@ -141,14 +141,19 @@ def cache_sharding(mesh: Mesh, n_kv_heads: int, batch: int,
 
 
 def paged_cache_sharding(mesh: Mesh, n_kv_heads: int,
-                         n_layers: int | None = None) -> NamedSharding:
-    """Paged pool [L, P, KV, page, Dh]: KV heads on model; the page dim is a
-    global pool indexed by the (replicated) page table, so it never shards.
-    In a pipelined engine the layer dim stages over ``pipe`` (each stage
-    holds its own layers' pages), mirroring the dense cache_sharding."""
+                         n_layers: int | None = None,
+                         num_pages: int | None = None) -> NamedSharding:
+    """Paged pool [L, P, KV, page, Dh]: KV heads on model. The page dim is
+    a global pool indexed by the (replicated) page table — unsharded,
+    EXCEPT in a seq-sharded engine, where it rides ``seq`` with
+    position-banded allocation (engine/paged.py: every chip's S-shard
+    reads only local pages). In a pipelined engine the layer dim stages
+    over ``pipe`` (each stage holds its own layers' pages), mirroring the
+    dense cache_sharding."""
     return NamedSharding(mesh, P(
         _axis(mesh, "pipe", n_layers) if n_layers else None,
-        None, _axis(mesh, "model", n_kv_heads), None, None))
+        _axis(mesh, "seq", num_pages) if num_pages else None,
+        _axis(mesh, "model", n_kv_heads), None, None))
 
 
 def batch_sharding(mesh: Mesh, batch: int) -> NamedSharding:

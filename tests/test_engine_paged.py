@@ -94,3 +94,59 @@ async def test_paged_concurrent_batching_no_corruption(paged_engine):
 def test_pool_too_small_for_one_request_rejected():
     with pytest.raises(ValueError, match="cannot hold"):
         _mk_engine(kv_num_pages=4)
+
+
+def test_banded_allocator_invariants_and_placement():
+    """Sequence-banded allocation (paged x seq): a slot's logical page j
+    must come from the physical band owning positions [j*page, ...), each
+    band's first page is its shard-local trash page, and release returns
+    pages to their own band's free list."""
+    from llmapigateway_tpu.engine.paged import PageAllocator
+
+    # 4 bands, 64 positions/slot, page 8 -> 8 logical pages/slot, 2/band.
+    a = PageAllocator(num_pages=32, page_size=8, batch=2, max_seq=64,
+                      n_bands=4)
+    assert a.free_pages == 32 - 4                 # 4 band trash pages
+    assert a.allocate(0, 64)
+    a.check_invariants()
+    row = a.table[0]
+    for j in range(8):
+        band = j // 2
+        assert row[j] // 8 == band, (j, row[j])   # page in its band
+        assert row[j] % 8 != 0                    # never a trash page
+    # Second slot fits too (2 pages/band each, 7 usable/band).
+    assert a.allocate(1, 64)
+    a.check_invariants()
+    a.release(0)
+    a.check_invariants()
+    assert a.allocate(0, 64)                      # re-admit after release
+    a.check_invariants()
+
+
+def test_banded_allocator_band_exhaustion():
+    """Admission must fail when ANY band is exhausted, even if other
+    bands have room (a slot needs pages in every band it touches)."""
+    from llmapigateway_tpu.engine.paged import PageAllocator
+
+    # 2 bands x 4 physical pages (3 usable each); slots need 2/band.
+    a = PageAllocator(num_pages=8, page_size=8, batch=4, max_seq=32,
+                      n_bands=2)
+    assert a.allocate(0, 32)
+    assert not a.can_admit(32)        # 1 page left per band, need 2
+    assert not a.allocate(1, 32)
+    # A short request touching only band 0 still fits.
+    assert a.can_admit(8)
+    assert a.allocate(2, 8)
+    a.check_invariants()
+
+
+def test_banded_allocator_validation():
+    from llmapigateway_tpu.engine.paged import PageAllocator
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="divisible"):
+        PageAllocator(num_pages=9, page_size=8, batch=1, max_seq=64,
+                      n_bands=4)
+    with _pytest.raises(ValueError, match="band boundaries"):
+        PageAllocator(num_pages=32, page_size=8, batch=1, max_seq=40,
+                      n_bands=4)

@@ -182,13 +182,66 @@ async def test_engine_ulysses_falls_back_when_heads_dont_divide():
     assert eng.seq_attention == "ring"
 
 
-async def test_engine_seq_mode_rejects_paged():
-    import pytest
+# ---------------------------------------------------------------------------
+# PAGED × SEQ (the headline KV layout under sequence parallelism): the
+# pool's page dim shards over `seq` with position-banded allocation, the
+# ring prefill writes through the shard_map'd banded scatter, and decode
+# gathers each chip's local pages into the dense S-sharded view for the
+# GSPMD-partitioned deferred attention. Composes with kv_quant and spec.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("engine_kw", [
+    {}, {"kv_quant": "int8"}, {"spec_draft_len": 3},
+    {"seq_attention": "ulysses", "n_dev": 2},
+])
+async def test_engine_seq_mode_with_paged_kv(engine_kw):
+    from llmapigateway_tpu.config.schemas import LocalEngineConfig
+    from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
+
+    kw = dict(engine_kw)
+    n_dev = kw.pop("n_dev", 4)
+    rng = np.random.default_rng(5)
+    prompt = list(np.tile(rng.integers(2, 500, 5), 8))
+
+    async def run(mesh, devs):
+        cfg = LocalEngineConfig(
+            preset="tiny-test", max_batch_size=2, max_seq_len=128,
+            prefill_chunk=32, dtype="float32", decode_burst=4,
+            kv_layout="paged", kv_page_size=16, mesh=mesh,
+            attention="reference", prewarm_sampler_variants=False,
+            compilation_cache_dir="off", **kw)
+        eng = InferenceEngine(cfg, devices=devs)
+        try:
+            req = GenRequest(prompt_ids=list(prompt), max_tokens=12,
+                             temperature=0.0)
+            await eng.submit(req)
+            async for _ in eng.stream(req):
+                pass
+            assert req.finish_reason is not None
+            return eng, req.generated
+        finally:
+            await eng.stop()
+
+    cpus = jax.devices("cpu")
+    eng_sp, toks_sp = await run({"seq": n_dev}, cpus[:n_dev])
+    pool_k = eng_sp.cache.k["q"] if isinstance(eng_sp.cache.k, dict) \
+        else eng_sp.cache.k
+    assert pool_k.sharding.spec[1] == "seq"       # page dim sharded
+    assert eng_sp.allocator.n_bands == n_dev      # banded allocation
+    eng_sp.allocator.check_invariants()
+    _, toks_ref = await run({}, cpus[:1])
+    assert toks_sp == toks_ref, (toks_sp, toks_ref)
+
+
+async def test_engine_paged_seq_validation():
+    import pytest as _pytest
     from llmapigateway_tpu.config.schemas import LocalEngineConfig
     from llmapigateway_tpu.engine.engine import InferenceEngine
 
-    with pytest.raises(ValueError, match="sequence parallelism"):
+    # Band boundaries must fall on page boundaries.
+    with _pytest.raises(ValueError, match="divisible by seq"):
         InferenceEngine(LocalEngineConfig(
-            preset="tiny-test", max_batch_size=2, max_seq_len=128,
-            mesh={"seq": 4}, kv_layout="paged"),
+            preset="tiny-test", max_batch_size=2, max_seq_len=96,
+            mesh={"seq": 4}, kv_layout="paged", kv_page_size=32,
+            compilation_cache_dir="off"),
             devices=jax.devices("cpu")[:4])
