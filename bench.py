@@ -156,7 +156,9 @@ def _other_python_procs() -> list[str]:
 
 def build_engine(args, kv_layout: str, preset: str | None = None,
                  batch: int | None = None, quant: str = "",
-                 kv_quant: str = "", burst: int | None = None):
+                 kv_quant: str = "", burst: int | None = None,
+                 seq: int | None = None, num_pages: int = 0,
+                 ttft_target: float = 0.0):
     import logging
     # The engine logs its init phase breakdown (params-ready seconds etc.)
     # at INFO — surface it so a slow cold start is attributable from the
@@ -173,10 +175,11 @@ def build_engine(args, kv_layout: str, preset: str | None = None,
     from llmapigateway_tpu.engine.engine import InferenceEngine
     cfg = LocalEngineConfig(
         preset=preset or args.preset, dtype="bfloat16",
-        max_batch_size=batch or args.batch, max_seq_len=args.seq,
+        max_batch_size=batch or args.batch, max_seq_len=seq or args.seq,
         prefill_chunk=min(512, args.prompt_len), quant=quant,
-        kv_quant=kv_quant,
+        kv_quant=kv_quant, kv_num_pages=num_pages,
         decode_burst=burst or args.burst, kv_layout=kv_layout,
+        ttft_target_ms=ttft_target,
         # Paged: the page IS the paged kernel's DMA block, so page
         # geometry sets its DMA efficiency — and its optimum (128) is NOT
         # the dense kernel's (256); see the paged_sweep phase.
@@ -212,6 +215,22 @@ def _model_footprint(engine) -> tuple[int, int]:
     return n, b
 
 
+def decode_footprint(prompt_len: int, steps: int, warmup: int,
+                     burst: int) -> tuple[int, int]:
+    """(warmup_steps, total_tokens) of fill_and_time_decode's workload.
+
+    ONE copy of this arithmetic: fill_and_time_decode sizes its paged
+    ``allocate()`` from it, and the capacity-crossover phase sizes its
+    page reservations and slot count from it — if they drifted apart the
+    crossover could under-reserve and silently decode through the trash
+    page."""
+    burst = max(1, burst)
+    tail = steps % burst
+    warmup_steps = burst + tail + (max(0, warmup - burst - tail)
+                                   // burst) * burst
+    return warmup_steps, prompt_len + warmup_steps + steps + 1
+
+
 def fill_and_time_decode(engine, args, steps: int | None = None) -> dict:
     """Fill every slot via prefill, then time steady-state decode through
     the engine's real hot loop (`_decode_burst`)."""
@@ -225,9 +244,8 @@ def fill_and_time_decode(engine, args, steps: int | None = None) -> dict:
     # must cover every step or the tail would write through the trash page.
     burst = max(1, engine.decode_burst)
     tail = steps % burst
-    warmup_steps = burst + tail + (max(0, args.warmup - burst - tail)
-                                   // burst) * burst
-    total_tokens = len(prompt) + warmup_steps + steps + 1
+    warmup_steps, total_tokens = decode_footprint(
+        len(prompt), steps, args.warmup, burst)
     if total_tokens > S:
         raise RuntimeError(
             f"--seq {S} too small for {len(prompt)} prompt + "
@@ -582,6 +600,16 @@ def main() -> None:
     ap.add_argument("--eight-b-batch", type=int, default=32)
     ap.add_argument("--eight-b-seq", type=int, default=512)
     ap.add_argument("--eight-b-steps", type=int, default=96)
+    ap.add_argument("--ttft-target", type=float, default=200.0,
+                    help="ttft_target_ms for the self-tuning TTFT rung "
+                         "(BASELINE: p50 < 200 ms under load)")
+    ap.add_argument("--crossover", type=int, default=1,
+                    help="equal-HBM capacity-crossover rung: paged admits "
+                         "budget/request slots vs dense's budget/max_seq "
+                         "(0 disables)")
+    ap.add_argument("--crossover-seq", type=int, default=2048,
+                    help="max_seq_len both crossover engines are "
+                         "provisioned for (the dense reservation unit)")
     ap.add_argument("--burst-sweep", type=int, default=1,
                     help="decode-burst 16/24 TTFT-vs-throughput sweep "
                          "(0 disables; args.burst itself is phase 1+2)")
@@ -754,6 +782,45 @@ def main() -> None:
                 extra["paged_sweep"]["vs_contiguous"] = round(
                     sweep[best_p] / contig_bf16_tok_s, 3)
 
+    # -- phase 3b: capacity crossover — paged vs dense at EQUAL KV HBM -------
+    # BASELINE config 3's real argument for paged KV (VERDICT r4 item 3): a
+    # dense engine must RESERVE max_seq_len contiguous tokens per slot, so
+    # at a fixed KV byte budget its concurrency is budget/max_seq_len; the
+    # paged pool reserves only each request's actual footprint rounded up
+    # to pages, so the SAME bytes admit budget/request_pages slots. Decode
+    # reads every weight byte once per STEP regardless of batch, so the
+    # extra slots convert the same HBM into more total tok/s — even if the
+    # per-step paged kernel carries an indirection tax.
+    if args.kv == "both" and args.crossover and not over_budget("crossover"):
+        x_seq = args.crossover_seq        # the context the service supports
+        budget_tokens = args.batch * x_seq
+        _, req_tokens = decode_footprint(args.prompt_len, args.steps,
+                                         args.warmup, args.burst)
+        pages_per_req = -(-req_tokens // args.page_size)
+        n_pages = budget_tokens // args.page_size          # equal bytes
+        raw = (n_pages - 1) // pages_per_req     # -1: the trash page
+        b_paged = min(raw - raw % 8 if raw >= 8 else raw, 64)
+        xr = {"kv_budget_tokens": budget_tokens, "max_seq_len": x_seq,
+              "request_tokens": req_tokens, "page_size": args.page_size,
+              "dense_slots": args.batch, "paged_slots": b_paged}
+        if req_tokens > x_seq or b_paged < 1:
+            xr["skipped"] = "request footprint >= provisioned context"
+        else:
+            try:
+                xeng, _ = build_engine(args, "contiguous", seq=x_seq)
+                xr["dense_tok_s"] = fill_and_time_decode(xeng, args)["tok_s"]
+                del xeng
+                xeng, _ = build_engine(args, "paged", batch=b_paged,
+                                       seq=x_seq, num_pages=n_pages)
+                xr["paged_tok_s"] = fill_and_time_decode(xeng, args)["tok_s"]
+                del xeng
+                xr["paged_vs_dense"] = round(
+                    xr["paged_tok_s"] / xr["dense_tok_s"], 3)
+            except Exception as e:
+                errors.append(f"crossover: {e!r}")
+                note(f"FAILED capacity-crossover phase: {e!r}")
+        extra["capacity_crossover"] = xr
+
     # -- phase 4d: int8 weight-quantization rung -----------------------------
     # Same shape as the headline; decode is weight-bandwidth-bound, so int8
     # weights should land near 2× the bf16 tok/s (models/quant.py). Reported
@@ -842,6 +909,31 @@ def main() -> None:
                     "ttft_p50_ms": extra.get("ttft_p50_ms"),
                     "ttft_p95_ms": extra.get("ttft_p95_ms")}
             extra["burst_sweep"] = bs_out
+
+    # -- phase 4g2: TTFT self-tuning rung (ttft_target_ms) -------------------
+    # The engine caps its idle-queue deep burst from its OWN step-time
+    # gauge so in-flight exposure spends at most half the target
+    # (engine._burst_depth). Measured through the real scheduler — the
+    # fill_and_time path calls _decode_burst directly and would bypass
+    # the adaptive depth entirely.
+    if (args.burst_sweep and not args.skip_ttft
+            and not over_budget("ttft_adaptive")):
+        try:
+            engine = None
+            engine, _ = build_engine(args, "contiguous",
+                                     ttft_target=args.ttft_target)
+            sched_tok_s = scheduler_throughput(engine, args)
+            reset_slots(engine)
+            t = measure_ttft_under_load(engine, args)
+            extra["ttft_adaptive"] = {
+                "target_ms": args.ttft_target,
+                "scheduler_tok_s": round(sched_tok_s, 1), **t}
+            note(f"ttft_adaptive: p50 {t['ttft_p50_ms']} ms, "
+                 f"{sched_tok_s:.1f} tok/s (target {args.ttft_target} ms)")
+            del engine
+        except Exception as e:
+            errors.append(f"ttft_adaptive: {e!r}")
+            note(f"FAILED ttft_adaptive phase: {e!r}")
 
     # -- phase 4: mid-size preset (MFU-vs-width rung) ------------------------
     if args.second_preset and not over_budget("second_preset"):

@@ -48,6 +48,17 @@ class LocalEngineConfig(BaseModel):
     # enough to amortize dispatch latency, shallow enough that admission
     # never waits long. 1 = legacy fully-synchronous busy stepping.
     decode_burst_busy: int = 4
+    # TTFT self-tuning (>0 enables): a dispatched decode scan cannot be
+    # preempted, so a probe arriving at an IDLE-queue engine waits out
+    # the in-flight deep burst before its prefill starts. With a target
+    # set, the engine caps the deep depth so that exposure spends at
+    # most half the target (the other half covers flush + prefill +
+    # first-token sampling), using its own measured steady-state
+    # step-time EMA — self-tuning across models/hardware where a fixed
+    # decode_burst is only right for one step time. The cap snaps to a
+    # compiled scan depth (deep, deep/2, busy) — arbitrary depths would
+    # fall off the fused-scan fast path.
+    ttft_target_ms: float = 0.0
     max_tokens_default: int = 1024
     # Prompt-lookup speculative decoding: draft N tokens per step from the
     # slot's own token history, verify in one T=N+1 forward (exact greedy

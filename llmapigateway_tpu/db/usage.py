@@ -134,7 +134,31 @@ class UsageDB:
                     GROUP BY period, model
                     ORDER BY period DESC, model""",
                 (start, end))
-            return [dict(r) for r in cur.fetchall()]
+            rows = [dict(r) for r in cur.fetchall()]
+            # p50/p95 TTFT per bucket (BASELINE's latency target is a
+            # PERCENTILE — a mean hides tail stalls). SQLite has no
+            # percentile aggregate, so pull the raw column and fold in
+            # Python; volumes are bounded by the 180-day retention sweep.
+            cur = self._conn.execute(
+                f"""SELECT strftime('{fmt}', timestamp) AS period, model,
+                           ttft_ms
+                    FROM tokens_usage
+                    WHERE timestamp >= ? AND timestamp <= ?
+                      AND ttft_ms IS NOT NULL""",
+                (start, end))
+            samples: dict[tuple[str, str], list[float]] = {}
+            for period_b, model, ttft in cur.fetchall():
+                samples.setdefault((period_b, model), []).append(float(ttft))
+        def pct(vals: list[float], q: float) -> float:
+            vals = sorted(vals)
+            i = q * (len(vals) - 1)
+            lo, hi = int(i), min(int(i) + 1, len(vals) - 1)
+            return vals[lo] + (vals[hi] - vals[lo]) * (i - lo)
+        for row in rows:
+            vals = samples.get((row["period"], row["model"]))
+            row["ttft_p50_ms"] = round(pct(vals, 0.50), 1) if vals else None
+            row["ttft_p95_ms"] = round(pct(vals, 0.95), 1) if vals else None
+        return rows
 
     def latest(self, limit: int = 25, offset: int = 0) -> list[dict[str, Any]]:
         with self._lock:

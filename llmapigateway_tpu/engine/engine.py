@@ -174,6 +174,14 @@ class InferenceEngine:
         self.decode_burst = max(1, engine_cfg.decode_burst)
         self.decode_burst_busy = max(1, min(engine_cfg.decode_burst_busy,
                                             self.decode_burst))
+        self.ttft_target_ms = max(0.0, engine_cfg.ttft_target_ms)
+        # Depths the fused decode scans are compiled for (lazily, on first
+        # use). With a TTFT target the half-deep rung gives the adaptive
+        # cap a real landing spot between deep and busy.
+        self._burst_depths = {self.decode_burst, self.decode_burst_busy}
+        if self.ttft_target_ms > 0:
+            self._burst_depths.add(max(1, self.decode_burst // 2))
+        self._burst_depths = tuple(sorted(self._burst_depths))
         if engine_cfg.kv_layout not in ("contiguous", "paged"):
             raise ValueError(f"unknown kv_layout {engine_cfg.kv_layout!r}")
         self.paged = engine_cfg.kv_layout == "paged"
@@ -195,8 +203,8 @@ class InferenceEngine:
                         f"paged × seq needs max_seq_len {self.S} divisible "
                         f"by seq × page = "
                         f"{self.seq_n * self.cfg.kv_page_size}")
-                # (SWA × paged and SWA × seq are each rejected by the
-                # sliding-window guardrails below.)
+                # (SWA × seq — paged or not — is rejected by the
+                # sliding-window guardrail below.)
             if self.S % self.seq_n:
                 raise ValueError(
                     f"max_seq_len {self.S} must be divisible by the seq "
@@ -273,19 +281,18 @@ class InferenceEngine:
                     "them quantized from the cache, so the output would "
                     "no longer be exactly the greedy sequence")
 
-        # Sliding-window attention (mistral family): v1 serves through the
-        # windowed dense paths — full GSPMD DP/TP/PP and speculation
-        # compose; the pallas kernels, the paged pool, and seq sharding
-        # don't carry the window yet and are excluded at build.
-        if model_cfg.sliding_window:
-            if self.paged:
-                raise ValueError(
-                    "sliding-window models need kv_layout=contiguous (v1: "
-                    "the paged kernels don't carry the window bound)")
-            if self.seq_n > 1:
-                raise ValueError(
-                    "sliding-window models do not compose with seq "
-                    "sharding (v1: ring/ulysses attention is unwindowed)")
+        # Sliding-window attention (mistral family): the windowed dense
+        # paths, the windowed flash kernels, AND the windowed paged
+        # kernels all carry the bound — a windowed paged decode reads
+        # O(window) *pages* (ops/paged_attention.py), compounding the SWA
+        # bandwidth win with paging's capacity win. Full GSPMD DP/TP/PP
+        # and speculation compose. Only seq sharding is excluded:
+        # ring/ulysses attention is unwindowed (and a 4k-window model
+        # has no sequence long enough to need S sharded).
+        if model_cfg.sliding_window and self.seq_n > 1:
+            raise ValueError(
+                "sliding-window models do not compose with seq "
+                "sharding (v1: ring/ulysses attention is unwindowed)")
 
         # Prompt-lookup speculative decoding (engine/speculative.py).
         self.spec_k = max(0, engine_cfg.spec_draft_len)
@@ -609,8 +616,7 @@ class InferenceEngine:
             return next_tokens, new_lengths, cache
 
         self._prefill_fn = prefill_step
-        self._decode_fns = _decode_programs(
-            one_step, (self.decode_burst, self.decode_burst_busy))
+        self._decode_fns = _decode_programs(one_step, self._burst_depths)
 
         if self.spec_k:
             from .speculative import make_spec_burst, make_spec_step
@@ -686,7 +692,8 @@ class InferenceEngine:
             # builder must be identity-stable for the pipeline's program
             # memo, hence ONE partial per engine.
             make_attn = partial(make_paged_attention_fn, max_seq=S,
-                                impl=impl, mesh=mesh)
+                                impl=impl, mesh=mesh,
+                                window=c.sliding_window)
             pipe_fwd = _pipelined_family_forward(self.mesh, self.pipe_n,
                                                  make_attention=make_attn)
 
@@ -719,7 +726,8 @@ class InferenceEngine:
             def call_forward(params, cache, table, tokens, lengths,
                              active=None, prefill=False):
                 attn = make_paged_attention_fn(table, max_seq=S, impl=impl,
-                                               mesh=mesh)
+                                               mesh=mesh,
+                                               window=c.sliding_window)
                 return family_forward(params, c, tokens, lengths, cache,
                                       active=active, attention_fn=attn)
 
@@ -769,8 +777,7 @@ class InferenceEngine:
                     PagedKVCache(k=cache.k, v=cache.v))
 
         self._prefill_fn = prefill_step
-        self._decode_fns = _decode_programs(
-            one_step, (self.decode_burst, self.decode_burst_busy))
+        self._decode_fns = _decode_programs(one_step, self._burst_depths)
 
         if self.spec_k:
             from .speculative import make_spec_burst, make_spec_step
@@ -1096,7 +1103,7 @@ class InferenceEngine:
                 step_tokens = await asyncio.to_thread(
                     self._spec_burst, max(1, burst))
             else:
-                burst = self.decode_burst_busy if busy else self.decode_burst
+                burst = self._burst_depth(busy)
                 # Never burst past any slot's cache capacity or token
                 # budget — both computed from DISPATCH-TRUE state
                 # (self.lengths advances at dispatch): with lag-one
@@ -1576,6 +1583,30 @@ class InferenceEngine:
             host = host.copy()
             host[:, ~live] = -1
         return [host[i] for i in range(n)]
+
+    def _burst_depth(self, busy: bool) -> int:
+        """Depth of the next normal decode burst.
+
+        Busy (work queued or prefilling): the shallow depth, so new work
+        interleaves within one shallow burst. Idle with ``ttft_target_ms``
+        set: an arriving probe cannot preempt the scan already dispatched,
+        so its TTFT floor is in-flight depth × step time plus the flush +
+        prefill chunk that follow admission — cap the deep depth so the
+        exposure spends at most HALF the target, sized by the engine's own
+        steady-state step-time gauge (``_ema_step_ms``). The cap snaps
+        DOWN to a compiled scan depth (``_burst_depths``): an arbitrary
+        depth would fall off the fused-scan fast path onto per-step
+        dispatch. Until the gauge has a sample, run the configured depth —
+        the first bursts are the measurement."""
+        if busy:
+            return self.decode_burst_busy
+        if self.ttft_target_ms > 0 and self._ema_step_ms:
+            cap = 0.5 * self.ttft_target_ms / self._ema_step_ms
+            fitting = [d for d in self._burst_depths if d <= cap]
+            if fitting:
+                return min(max(fitting), self.decode_burst)
+            return self._burst_depths[0]
+        return self.decode_burst
 
     def _decode_burst(self, n_steps: int) -> list[np.ndarray]:
         """Run `n_steps` chained decode steps; tokens/lengths feed back as

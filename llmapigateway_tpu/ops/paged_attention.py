@@ -178,7 +178,7 @@ def paged_insert_all(pool_k, pool_v,
 # ---------------------------------------------------------------------------
 
 def _paged_decode_kernel(pt_ref, nvalid_ref, q_ref, kn_ref, vn_ref,
-                         *refs, page: int):
+                         *refs, page: int, window: int = 0):
     k_ref, ks_ref, v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = \
         unpack_kv_refs(refs)
     b = pl.program_id(0)
@@ -190,13 +190,26 @@ def _paged_decode_kernel(pt_ref, nvalid_ref, q_ref, kn_ref, vn_ref,
         self_column_init(q_ref, kn_ref, vn_ref, m_ref, l_ref, acc_ref)
 
     n_valid = nvalid_ref[b]
+    # Sliding window (ops/flash_attention.py _decode_kernel is the dense
+    # twin): the query at position n_valid sees stale keys p with
+    # n_valid - p < window, i.e. p >= w0. Pages wholly below w0 skip
+    # compute here AND their HBM→VMEM DMA (the index-map clamp makes them
+    # repeat an in-window physical page) — a windowed paged decode reads
+    # O(window) pages, not O(context): SWA's whole point, compounded.
+    w0 = jnp.maximum(n_valid - (window - 1), 0) if window else 0
+    live = j * page < n_valid
+    if window:
+        live = live & ((j + 1) * page > w0)
 
-    @pl.when(j * page < n_valid)
+    @pl.when(live)
     def _block():
         def mask(scores):
             pos = j * page + jax.lax.broadcasted_iota(
                 jnp.int32, scores.shape, 1)
-            return jnp.where(pos < n_valid, scores, NEG_INF)
+            ok = pos < n_valid
+            if window:
+                ok = ok & (pos >= w0)
+            return jnp.where(ok, scores, NEG_INF)
         attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask,
                      ks_ref, vs_ref)
 
@@ -210,6 +223,7 @@ def paged_decode_attention(q: jax.Array, k_new: jax.Array,
                            v_new: jax.Array, k_pages, v_pages,
                            page_table: jax.Array,
                            n_stale: jax.Array, *,
+                           window: int = 0,
                            interpret: bool | None = None) -> jax.Array:
     """Ragged single-token attention over the STALE page pool plus the new
     token (self column folded into the online-softmax init).
@@ -217,7 +231,9 @@ def paged_decode_attention(q: jax.Array, k_new: jax.Array,
     q: [B, H, Dh] (RoPE applied); k_new/v_new: [B, KV, Dh];
     k_pages/v_pages: [P, KV, page, Dh] or the int8 ``{"q","s"}`` dicts;
     page_table: [B, NP]; n_stale: [B] int32 (the query's position; 0 for a
-    fresh slot). Returns [B, H*Dh].
+    fresh slot). ``window``: sliding-window bound (mistral family; 0 =
+    full) — pages wholly out of window skip compute and DMA, so a
+    windowed decode reads O(window) pages. Returns [B, H*Dh].
     """
     B, H, Dh = q.shape
     quant = isinstance(k_pages, dict)
@@ -228,13 +244,26 @@ def paged_decode_attention(q: jax.Array, k_new: jax.Array,
     qg = q.reshape(B, KV, G, Dh)
     grid = (B, KV, NP)
 
+    def _live_range(nv_b):
+        """(first, last) live logical page — out-of-range iterations
+        re-reference a live physical page so their DMA is elided
+        (pl.when skips their compute); flash_attention._live_range is
+        the dense twin."""
+        last = jnp.maximum((nv_b + page - 1) // page - 1, 0)
+        if window:
+            first = jnp.minimum(
+                jnp.maximum(nv_b - (window - 1), 0) // page, last)
+        else:
+            first = 0
+        return first, last
+
     def kv_index(b, h, j, pt, nv):
-        last = jnp.maximum((nv[b] + page - 1) // page - 1, 0)
-        return pt[b, jnp.minimum(j, last)], h, 0, 0
+        first, last = _live_range(nv[b])
+        return pt[b, jnp.clip(j, first, last)], h, 0, 0
 
     def scale_index(b, h, j, pt, nv):
-        last = jnp.maximum((nv[b] + page - 1) // page - 1, 0)
-        return pt[b, jnp.minimum(j, last)], h, 0
+        first, last = _live_range(nv[b])
+        return pt[b, jnp.clip(j, first, last)], h, 0
 
     kv_spec = pl.BlockSpec((1, 1, page, Dh), kv_index)
     s_spec = pl.BlockSpec((1, 1, page), scale_index)
@@ -247,7 +276,7 @@ def paged_decode_attention(q: jax.Array, k_new: jax.Array,
         kv_specs = [kv_spec, kv_spec]
 
     out = pl.pallas_call(
-        functools.partial(_paged_decode_kernel, page=page),
+        functools.partial(_paged_decode_kernel, page=page, window=window),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
@@ -280,7 +309,7 @@ def paged_decode_attention(q: jax.Array, k_new: jax.Array,
 # ---------------------------------------------------------------------------
 
 def _paged_prefill_kernel(pt_ref, start_ref, q_ref, *refs,
-                          block_t: int, page: int):
+                          block_t: int, page: int, window: int = 0):
     k_ref, ks_ref, v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = \
         unpack_kv_refs(refs)
     b = pl.program_id(0)
@@ -295,16 +324,28 @@ def _paged_prefill_kernel(pt_ref, start_ref, q_ref, *refs,
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
     start = start_ref[b]
-    last_q_pos = start + t * block_t + (block_t - 1)
+    first_q_pos = start + t * block_t
+    last_q_pos = first_q_pos + (block_t - 1)
 
-    @pl.when(j * page <= last_q_pos)
+    # Causal upper bound; with a sliding window also a lower bound — a
+    # page is dead unless its last key position is within `window` of the
+    # block's FIRST query (flash_attention._chunk_kernel is the dense
+    # twin). Dead pages skip compute and DMA (index-map clamp).
+    live = j * page <= last_q_pos
+    if window:
+        live = live & ((j + 1) * page - 1 > first_q_pos - window)
+
+    @pl.when(live)
     def _block():
         def mask(scores):
-            q_pos = start + t * block_t + jax.lax.broadcasted_iota(
+            q_pos = first_q_pos + jax.lax.broadcasted_iota(
                 jnp.int32, scores.shape, 0)
             s_pos = j * page + jax.lax.broadcasted_iota(
                 jnp.int32, scores.shape, 1)
-            return jnp.where(s_pos <= q_pos, scores, NEG_INF)
+            ok = s_pos <= q_pos
+            if window:
+                ok = ok & (s_pos > q_pos - window)
+            return jnp.where(ok, scores, NEG_INF)
         attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask,
                      ks_ref, vs_ref)
 
@@ -318,12 +359,15 @@ def _paged_prefill_kernel(pt_ref, start_ref, q_ref, *refs,
 def paged_prefill_attention(q: jax.Array, k_pages, v_pages,
                             page_table: jax.Array,
                             start: jax.Array, *, block_t: int = 128,
+                            window: int = 0,
                             interpret: bool | None = None) -> jax.Array:
     """Causal chunk attention over the page pool (keys already inserted).
 
     q: [B, T, H, Dh] at absolute positions ``start + t``;
     k_pages/v_pages: [P, KV, page, Dh] or the int8 ``{"q","s"}`` dicts;
-    page_table: [B, NP]; start: [B]. Returns [B, T, H*Dh].
+    page_table: [B, NP]; start: [B]. ``window``: sliding-window bound
+    (0 = full causal) — out-of-window pages skip compute and DMA.
+    Returns [B, T, H*Dh].
     """
     B, T, H, Dh = q.shape
     quant = isinstance(k_pages, dict)
@@ -337,13 +381,23 @@ def paged_prefill_attention(q: jax.Array, k_pages, v_pages,
     qh = q.transpose(0, 2, 1, 3)
     grid = (B, H, T // block_t, NP)
 
+    def _live_range(st_b, t):
+        first_q = st_b + t * block_t
+        last = (first_q + block_t - 1) // page
+        if window:
+            first = jnp.minimum(
+                jnp.maximum(first_q - (window - 1), 0) // page, last)
+        else:
+            first = 0
+        return first, last
+
     def kv_index(b, h, t, j, pt, st):
-        last_q_pos = st[b] + t * block_t + (block_t - 1)
-        return pt[b, jnp.minimum(j, last_q_pos // page)], h // G, 0, 0
+        first, last = _live_range(st[b], t)
+        return pt[b, jnp.clip(j, first, last)], h // G, 0, 0
 
     def scale_index(b, h, t, j, pt, st):
-        last_q_pos = st[b] + t * block_t + (block_t - 1)
-        return pt[b, jnp.minimum(j, last_q_pos // page)], h // G, 0
+        first, last = _live_range(st[b], t)
+        return pt[b, jnp.clip(j, first, last)], h // G, 0
 
     kv_spec = pl.BlockSpec((1, 1, page, Dh), kv_index)
     s_spec = pl.BlockSpec((1, 1, page), scale_index)
@@ -356,7 +410,8 @@ def paged_prefill_attention(q: jax.Array, k_pages, v_pages,
         kv_specs = [kv_spec, kv_spec]
 
     out = pl.pallas_call(
-        functools.partial(_paged_prefill_kernel, block_t=block_t, page=page),
+        functools.partial(_paged_prefill_kernel, block_t=block_t, page=page,
+                          window=window),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
@@ -401,7 +456,8 @@ def gather_pages(layer_pages, page_table: jax.Array, max_seq: int):
     return seq[:, :, :max_seq]
 
 
-def _paged_reference_core(q, dense_k, dense_v, lengths, active, T):
+def _paged_reference_core(q, dense_k, dense_v, lengths, active, T,
+                          window: int = 0):
     """Dense attention over a gathered view WITHOUT re-inserting."""
     B, H = q.shape[0], q.shape[2]
     KV, S = dense_k.shape[1], dense_k.shape[2]
@@ -415,6 +471,9 @@ def _paged_reference_core(q, dense_k, dense_v, lengths, active, T):
     q_pos = lengths[:, None] + jnp.arange(T)[None, :]
     s_idx = jnp.arange(S)[None, None, :]
     visible = s_idx <= q_pos[:, :, None]
+    if window:
+        # HF Mistral semantics: key s visible to query i iff i - s < window.
+        visible = visible & (s_idx > q_pos[:, :, None] - window)
     if active is not None:
         visible = visible & active[:, None, None]
     scores = jnp.where(visible[:, None, :, :], scores, NEG_INF)
@@ -427,7 +486,7 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
                             impl: str = "pallas",
                             block_t: int | None = None,
                             interpret: bool | None = None,
-                            mesh=None):
+                            mesh=None, window: int = 0):
     """Build an ``attention_fn`` (llama.forward contract) over a paged cache.
 
     Constructed INSIDE the engine's jitted step function, closing over the
@@ -474,7 +533,7 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
             dense_v = _dequant_dense(
                 gather_pages(layer_v, page_table, max_seq), q.dtype)
             out = _paged_reference_core(q, dense_k, dense_v, lengths,
-                                        active, T)
+                                        active, T, window=window)
             return out, layer_k, layer_v
         shard = msize > 1 and KV % msize == 0 and H % msize == 0
         pool = _pool_spec(layer_k)
@@ -482,7 +541,8 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
         if shard:
             f = jax.shard_map(
                 lambda q_, k_, v_, pt_, st_: paged_prefill_attention(
-                    q_, k_, v_, pt_, st_, block_t=bt, interpret=interpret),
+                    q_, k_, v_, pt_, st_, block_t=bt, window=window,
+                    interpret=interpret),
                 mesh=mesh,
                 in_specs=(P(None, None, "model", None), pool, pool,
                           P(None, None), P(None)),
@@ -492,7 +552,7 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
         else:
             out = paged_prefill_attention(
                 q, layer_k, layer_v, page_table, lengths,
-                block_t=bt, interpret=interpret)
+                block_t=bt, window=window, interpret=interpret)
         return out, layer_k, layer_v
 
     def decode(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
@@ -508,13 +568,14 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
             dense_k = gather_pages(layer_k, page_table, max_seq)
             dense_v = gather_pages(layer_v, page_table, max_seq)
             return dense_decode_attention(q, k_new, v_new, dense_k, dense_v,
-                                          n_stale, None)
+                                          n_stale, None, window=window)
         shard = msize > 1 and KV % msize == 0 and H % msize == 0
         pool = _pool_spec(layer_k)
         if shard:
             f = jax.shard_map(
                 lambda q_, kn_, vn_, k_, v_, pt_, nv_: paged_decode_attention(
-                    q_, kn_, vn_, k_, v_, pt_, nv_, interpret=interpret),
+                    q_, kn_, vn_, k_, v_, pt_, nv_, window=window,
+                    interpret=interpret),
                 mesh=mesh,
                 in_specs=(P(None, "model", None), P(None, "model", None),
                           P(None, "model", None), pool, pool,
@@ -526,7 +587,7 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
         else:
             out = paged_decode_attention(
                 q[:, 0], k_new[:, 0], v_new[:, 0], layer_k, layer_v,
-                page_table, n_stale, interpret=interpret)
+                page_table, n_stale, window=window, interpret=interpret)
         return out[:, None, :]
 
     def insert_all(pool_k, pool_v, k_news, v_news, lengths, active):

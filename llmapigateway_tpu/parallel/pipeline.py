@@ -67,15 +67,17 @@ def _block_forward(lp_block: dict, c: ModelConfig, x: jax.Array,
     contract as llama.forward's).
 
     Decode ticks (T == 1) run the DEFERRED-insert protocol exactly when
-    the attention PROVIDER carries it (same dispatch as llama.forward —
-    the paged provider always does): per-layer functional cache updates
-    inside the scan serialize into 2·L scatters per step, while the
-    deferred form attends the stale pool plus the self-column and lands
-    ONE stacked insert after the scan, keeping the full pool OUT of the
-    scan's ys. The dense default stays insert-then-attend, bit-matching
-    llama.forward's default path (a deferred two-piece softmax rounds
-    differently and would flip greedy ties against the non-pipelined
-    engine). Chunks always insert-then-attend."""
+    the attention provider carries it — the SAME dispatch as llama.forward,
+    including the dense default and the windowed (Mistral) default, both of
+    which carry ``.decode``/``.insert_all`` (models/llama.py:493-494,:506).
+    Per-layer functional cache updates inside the scan would serialize into
+    2·L scatters per step; the deferred form attends the stale cache plus
+    the self-column and lands ONE stacked insert after the scan, keeping
+    the full cache out of the scan's ys. Because the SAME decode kernel
+    runs pipelined and non-pipelined, greedy outputs bit-match the
+    non-pipelined engine even on float rounding ties. Chunks (T > 1) stay
+    insert-then-attend, as in llama.forward for providers without
+    ``.verify``."""
     B, T, _ = x.shape
     if attention_fn is None and c.sliding_window:
         # Mistral-family: the default dense path carries the window.
@@ -83,9 +85,9 @@ def _block_forward(lp_block: dict, c: ModelConfig, x: jax.Array,
     else:
         attend = attention_fn or llama.dense_cache_attention
     decode_attend = insert_all = None
-    if T == 1 and attention_fn is not None:
-        decode_attend = getattr(attention_fn, "decode", None)
-        insert_all = getattr(attention_fn, "insert_all", None)
+    if T == 1:
+        decode_attend = getattr(attend, "decode", None)
+        insert_all = getattr(attend, "insert_all", None)
     deferred = decode_attend is not None and insert_all is not None
 
     def layer_step(x, scanned):

@@ -2,7 +2,7 @@
    period aggregate tables with per-bucket grouping and the derived Cost/Million
    column (cost / total_tokens * 1e6 — usage-stats.js:80-85 in the reference),
    paginated raw-records tab (25/page), dark mode — plus the TPU serving
-   columns (avg TTFT, avg tok/s) this framework's usage schema records. */
+   columns (p50/p95 TTFT, avg tok/s) this framework's usage schema records. */
 "use strict";
 
 const $ = (id) => document.getElementById(id);
@@ -99,7 +99,7 @@ function renderAgg(rows) {
   if (!rows.length) {
     const tr = document.createElement("tr");
     const cell = td("no usage recorded in this window", "empty");
-    cell.colSpan = 11;
+    cell.colSpan = 12;
     tr.appendChild(cell);
     body.appendChild(tr);
     return;
@@ -115,7 +115,7 @@ function renderAgg(rows) {
     const hdr = document.createElement("tr");
     hdr.className = "bucket";
     const cell = td(BUCKET_LABEL[currentPeriod](bucket));
-    cell.colSpan = 11;
+    cell.colSpan = 12;
     hdr.appendChild(cell);
     body.appendChild(hdr);
 
@@ -132,7 +132,8 @@ function renderAgg(rows) {
       tr.appendChild(td(fmtInt(r.total_tokens)));
       tr.appendChild(td(fmtCost(r.cost)));
       tr.appendChild(td(costPerMillion(r.cost, r.total_tokens)));
-      tr.appendChild(td(fmt1(r.avg_ttft_ms)));
+      tr.appendChild(td(fmt1(r.ttft_p50_ms)));
+      tr.appendChild(td(fmt1(r.ttft_p95_ms)));
       tr.appendChild(td(fmt1(r.avg_tokens_per_sec)));
       body.appendChild(tr);
       tot.requests += r.requests || 0;
@@ -155,6 +156,7 @@ function renderAgg(rows) {
       tr.appendChild(td(fmtInt(tot.total)));
       tr.appendChild(td(fmtCost(tot.cost)));
       tr.appendChild(td(costPerMillion(tot.cost, tot.total)));
+      tr.appendChild(td("—"));
       tr.appendChild(td("—"));
       tr.appendChild(td("—"));
       body.appendChild(tr);

@@ -23,8 +23,9 @@ def test_bench_emits_contract_json_line():
          "--long-steps", "4",
          "--eight-b-preset", "tiny-test", "--eight-b-batch", "2",
          "--eight-b-seq", "128", "--eight-b-steps", "4",
-         "--burst-sweep", "0", "--spec-mixed-tokens", "16"],
-        capture_output=True, text=True, timeout=480, env=env, cwd=REPO)
+         "--burst-sweep", "0", "--spec-mixed-tokens", "16",
+         "--crossover-seq", "256"],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO)
     assert r.returncode == 0, r.stderr[-2000:]
     lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
     assert len(lines) == 1, f"expected ONE json line, got: {r.stdout!r}"
@@ -39,9 +40,14 @@ def test_bench_emits_contract_json_line():
                   "roofline_fraction", "paged_tok_s", "second_preset",
                   "batch_scale", "speculative", "quant_int8",
                   "quant_int8_kv8", "long_ctx", "headline_8b",
-                  "paged_sweep", "north_star", "spec_mixed"):
+                  "paged_sweep", "north_star", "spec_mixed",
+                  "capacity_crossover"):
         assert field in extra, (field, sorted(extra))
     # The paged sweep measured both page sizes and named a winner.
     assert set(extra["paged_sweep"]) >= {"128", "256", "best_page_size"}
+    # Equal-HBM crossover ran both legs with paged admitting more slots.
+    xr = extra["capacity_crossover"]
+    assert xr["paged_slots"] > xr["dense_slots"], xr
+    assert "paged_vs_dense" in xr, xr
     assert extra["headline_8b"]["quant"] == "int8"
     assert "phase_errors" not in extra, extra["phase_errors"]

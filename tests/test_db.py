@@ -41,6 +41,11 @@ def test_usage_insert_aggregate_latest(tmp_path):
     assert by_model["m1"]["total_tokens"] == 150
     assert by_model["m1"]["requests"] == 5
     assert abs(by_model["m1"]["avg_ttft_ms"] - 150.0) < 1e-6
+    # Percentile columns (VERDICT r4 item 8): p50/p95 over the bucket's
+    # raw samples; a model with no TTFT samples reports None, not 0.
+    assert abs(by_model["m1"]["ttft_p50_ms"] - 150.0) < 1e-6
+    assert abs(by_model["m1"]["ttft_p95_ms"] - 150.0) < 1e-6
+    assert by_model["m2"]["ttft_p50_ms"] is None
     db.close()
 
 

@@ -288,3 +288,45 @@ async def test_ttft_under_load_first_token_within_bounded_steps():
             pass
     finally:
         await eng.stop()
+
+
+def test_ttft_target_caps_idle_burst_depth():
+    """With ttft_target_ms set, the idle-queue deep burst depth is capped
+    by the engine's own step-time gauge (half the target), snapping DOWN
+    to a compiled scan depth; busy depth and the no-gauge warmup are
+    unaffected. (VERDICT r4 item 2: TTFT exposure is the in-flight
+    burst — a fixed deep depth is only right for one step time.)"""
+    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+                            max_seq_len=64, prefill_chunk=16,
+                            dtype="float32", decode_burst=32,
+                            decode_burst_busy=4, ttft_target_ms=100.0)
+    eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
+    # The half-deep rung is compiled alongside deep and busy.
+    assert set(eng._burst_depths) == {4, 16, 32}
+    # No gauge yet: run configured depth (the first bursts measure it).
+    assert eng._burst_depth(busy=False) == 32
+    assert eng._burst_depth(busy=True) == 4
+    # 2 ms/step -> 50 ms budget -> cap 25 -> snaps down to the 16 rung.
+    eng._ema_step_ms = 2.0
+    assert eng._burst_depth(busy=False) == 16
+    # Fast steps: full depth fits the budget.
+    eng._ema_step_ms = 1.0
+    assert eng._burst_depth(busy=False) == 32
+    # Slow steps: even the busy depth overruns -> shallowest rung.
+    eng._ema_step_ms = 40.0
+    assert eng._burst_depth(busy=False) == 4
+    # Busy path ignores the target entirely.
+    eng._ema_step_ms = 2.0
+    assert eng._burst_depth(busy=True) == 4
+
+
+def test_no_ttft_target_keeps_fixed_depths():
+    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+                            max_seq_len=64, prefill_chunk=16,
+                            dtype="float32", decode_burst=8,
+                            decode_burst_busy=2)
+    eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
+    assert set(eng._burst_depths) == {2, 8}
+    eng._ema_step_ms = 50.0              # gauge present but target unset
+    assert eng._burst_depth(busy=False) == 8
+    assert eng._burst_depth(busy=True) == 2

@@ -58,5 +58,5 @@ async def test_every_endpoint_the_js_calls_exists(tmp_path):
 async def test_ui_page_lists_usage_columns(tmp_path):
     """The stats page must surface the extended serving metrics columns."""
     html = (STATIC / "usage-stats.html").read_text()
-    for col in ("$/Million", "TTFT ms", "tok/s"):
+    for col in ("$/Million", "TTFT p50", "TTFT p95", "tok/s"):
         assert col in html
