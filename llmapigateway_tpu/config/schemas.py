@@ -91,7 +91,10 @@ class LocalEngineConfig(BaseModel):
     # int8 (activations quantize dynamically inside the step;
     # models/quant.py). Halves the weight bytes each decode step streams
     # from HBM — the decode roofline — at a small accuracy cost (W8A8).
-    quant: str = ""                 # "" | "int8"
+    # "int4" packs the LAYER matmuls to 4-bit (lm_head stays int8):
+    # ~45% fewer weight bytes again, at a larger quality cost users opt
+    # into per-provider (W4A8; mixed s8×s4 dot_general).
+    quant: str = ""                 # "" | "int8" | "int4"
     # KV-cache quantization: "int8" stores K/V as symmetric per-token
     # per-head int8 (+ fp32 scales, ~6% overhead) — halves KV bandwidth
     # AND capacity footprint, the long-context/high-concurrency lever.

@@ -345,7 +345,7 @@ class InferenceEngine:
             from .checkpoint import _np_dtype, load_checkpoint
             from ..parallel.sharding import spec_for_param
             from ..models.quant import (QUANT_TOP_KEYS, _np_quantize,
-                                        quantizes)
+                                        quantizes, weight_bits)
 
             def put(path: str, arr: np.ndarray) -> jax.Array:
                 # ".q"/".s" quantized sub-leaves get their own rules.
@@ -357,14 +357,15 @@ class InferenceEngine:
                 # SOURCE precision (not a bf16-rounded copy), per layer,
                 # before stacking — the host stacks and transfers the int8
                 # copy, halving both footprints.
-                if self.quant == "int8" and quantizes(path):
+                if self.quant and quantizes(path):
                     return _np_quantize(
-                        arr, 1 if path in QUANT_TOP_KEYS else 0)
+                        arr, 1 if path in QUANT_TOP_KEYS else 0,
+                        bits=weight_bits(self.quant, path))
                 return arr.astype(_np_dtype(self.dtype))
             self.params = load_checkpoint(self.cfg.model_path, c,
                                           dtype=self.dtype, put=put,
                                           preprocess=preprocess)
-            if (self.quant == "int8" and c.tie_embeddings
+            if (self.quant and c.tie_embeddings
                     and "lm_head_q8" not in self.params):
                 # Tied checkpoints ship no lm_head tensor, so the preprocess
                 # hook never saw one to quantize — build the int8 head copy
@@ -391,9 +392,9 @@ class InferenceEngine:
             # each process computing only its addressable shards.
             def build(k):
                 p = init_fn(c)(c, k, dtype=self.dtype)
-                if self.quant == "int8":
+                if self.quant:
                     from ..models.quant import quantize_tree
-                    p = quantize_tree(p, c)
+                    p = quantize_tree(p, c, self.quant)
                 return p
             key = jax.random.PRNGKey(0)
             shapes = jax.eval_shape(build, key)

@@ -1,4 +1,4 @@
-"""Int8 weight quantization for the serving engine (W8A8 dynamic).
+"""Int8/int4 weight quantization for the serving engine (W8A8 / W4A8).
 
 No reference counterpart — the reference proxies HTTP and never touches
 weights (SURVEY.md §2: no model execution anywhere). This is a TPU-native
@@ -30,6 +30,14 @@ Scheme (standard dynamic W8A8, no calibration data needed):
 plain array or a quantized dict, so model code (models/llama.py) is layout-
 agnostic and a checkpoint loaded with ``quant: "int8"`` streams through the
 same forward as a bf16 one.
+
+``quant: "int4"`` (W4A8) stores the layer matmuls as **int4** (levels
+±7, same per-channel scheme) while the lm_head stays int8. The dots run
+as mixed s8×s4 ``dot_general`` — XLA contracts the int4 operand
+directly, and on TPU the packed-int4 HBM layout is what matters: decode
+is weight-bandwidth-bound, so int4 MLP/attention weights cut the
+per-step stream ~45% past int8 at a quality cost users opt into
+per-provider.
 """
 from __future__ import annotations
 
@@ -46,29 +54,48 @@ QUANT_LAYER_KEYS = frozenset({"wq", "wk", "wv", "wo", "wg", "wu", "wd"})
 # Top-level weights that quantize ([V, D], contract over D → scale per V).
 QUANT_TOP_KEYS = frozenset({"lm_head"})
 
-QUANT_MODES = ("", "int8")
+QUANT_MODES = ("", "int8", "int4")
+
+
+def weight_bits(mode: str, path: str) -> int:
+    """Bit width for a quantizable path under a quant mode. ``int4``
+    applies to the stacked layer matmuls (wq/wk/wv/wo/wg/wu/wd — they
+    carry ~90% of a llama-family model's weight bytes and tolerate 4-bit
+    per-channel rounding); the lm_head stays int8 in int4 mode — the
+    logits matmul decides every sampled token and is the one projection
+    where 4-bit rounding moves argmax measurably, for ~6% of the bytes."""
+    if mode == "int4" and path not in QUANT_TOP_KEYS:
+        return 4
+    return 8
 
 
 def is_quantized(w: Any) -> bool:
     return isinstance(w, dict) and "q" in w and "s" in w
 
 
-def _np_quantize(arr: np.ndarray, contract_axis: int) -> dict[str, np.ndarray]:
+def _np_quantize(arr: np.ndarray, contract_axis: int,
+                 bits: int = 8) -> dict[str, np.ndarray]:
     """Host-side symmetric per-channel quantization (checkpoint load path —
-    the int8 copy, not the bf16 original, is what crosses PCIe/DCN)."""
+    the int8/int4 copy, not the bf16 original, is what crosses PCIe/DCN)."""
+    from ml_dtypes import int4
+    levels = (1 << (bits - 1)) - 1          # 127 (int8) / 7 (int4)
     f = np.asarray(arr, np.float32)
     amax = np.max(np.abs(f), axis=contract_axis, keepdims=True)
-    scale = np.maximum(amax, 1e-30) / 127.0
-    q = np.clip(np.rint(f / scale), -127, 127).astype(np.int8)
+    scale = np.maximum(amax, 1e-30) / levels
+    q = np.clip(np.rint(f / scale), -levels, levels) \
+        .astype(np.int8 if bits == 8 else int4)
     return {"q": q, "s": np.squeeze(scale, axis=contract_axis)}
 
 
-def quantize_array(w: jax.Array, contract_axis: int) -> dict[str, jax.Array]:
+def quantize_array(w: jax.Array, contract_axis: int,
+                   bits: int = 8) -> dict[str, jax.Array]:
     """Device-side twin of :func:`_np_quantize` (random-init path)."""
+    levels = (1 << (bits - 1)) - 1
     f = w.astype(jnp.float32)
     amax = jnp.max(jnp.abs(f), axis=contract_axis, keepdims=True)
-    scale = jnp.maximum(amax, 1e-30) / 127.0
-    q = jnp.clip(jnp.round(f / scale), -127, 127).astype(jnp.int8)
+    scale = jnp.maximum(amax, 1e-30) / levels
+    q = jnp.clip(jnp.round(f / scale), -levels, levels) \
+        .astype(jnp.int8 if bits == 8 else jnp.int4)
     return {"q": q, "s": jnp.squeeze(scale, axis=contract_axis)}
 
 
@@ -93,7 +120,8 @@ def contract_axis_for(path: str, ndim: int) -> int | None:
     return 1        # lm_head [V, D] → per-V; layers [L, D_in, D_out] → dim 1
 
 
-def quantize_tree(params: dict, config: ModelConfig) -> dict:
+def quantize_tree(params: dict, config: ModelConfig,
+                  mode: str = "int8") -> dict:
     """Replace every quantizable leaf of a params tree with its
     ``{"q", "s"}`` dict (random-init path; checkpoint load quantizes
     per-parameter on the host instead — engine/checkpoint.py put hook).
@@ -108,13 +136,15 @@ def quantize_tree(params: dict, config: ModelConfig) -> dict:
     for key, val in params.items():
         if key == "layers":
             out[key] = {
-                k: (quantize_array(v, contract_axis_for(f"layers.{k}", v.ndim))
+                k: (quantize_array(v, contract_axis_for(f"layers.{k}", v.ndim),
+                                   bits=weight_bits(mode, f"layers.{k}"))
                     if contract_axis_for(f"layers.{k}", v.ndim) is not None
                     else v)
                 for k, v in val.items()
             }
         elif contract_axis_for(key, getattr(val, "ndim", 0)) is not None:
-            out[key] = quantize_array(val, contract_axis_for(key, val.ndim))
+            out[key] = quantize_array(val, contract_axis_for(key, val.ndim),
+                                      bits=weight_bits(mode, key))
         else:
             out[key] = val
     if config.tie_embeddings and "lm_head" not in params:

@@ -24,7 +24,9 @@ def test_bench_emits_contract_json_line():
          "--eight-b-preset", "tiny-test", "--eight-b-batch", "2",
          "--eight-b-seq", "128", "--eight-b-steps", "4",
          "--burst-sweep", "0", "--spec-mixed-tokens", "16",
-         "--crossover-seq", "256"],
+         "--crossover-seq", "256",
+         "--swa-preset", "tiny-mistral-test", "--swa-seq", "128",
+         "--swa-prompt", "32", "--swa-batch", "2", "--swa-steps", "4"],
         capture_output=True, text=True, timeout=900, env=env, cwd=REPO)
     assert r.returncode == 0, r.stderr[-2000:]
     lines = [ln for ln in r.stdout.strip().splitlines() if ln.strip()]
@@ -41,7 +43,7 @@ def test_bench_emits_contract_json_line():
                   "batch_scale", "speculative", "quant_int8",
                   "quant_int8_kv8", "long_ctx", "headline_8b",
                   "paged_sweep", "north_star", "spec_mixed",
-                  "capacity_crossover"):
+                  "capacity_crossover", "swa", "quant_int4_kv8"):
         assert field in extra, (field, sorted(extra))
     # The paged sweep measured both page sizes and named a winner.
     assert set(extra["paged_sweep"]) >= {"128", "256", "best_page_size"}
@@ -50,4 +52,6 @@ def test_bench_emits_contract_json_line():
     assert xr["paged_slots"] > xr["dense_slots"], xr
     assert "paged_vs_dense" in xr, xr
     assert extra["headline_8b"]["quant"] == "int8"
+    # BASELINE config 3 is paged: the north-star rung measures both layouts.
+    assert "paged_vs_contiguous" in extra["headline_8b"]
     assert "phase_errors" not in extra, extra["phase_errors"]

@@ -402,8 +402,130 @@ def test_moe_engine_e2e_with_quant():
 def test_quant_rejects_unknown_mode():
     from llmapigateway_tpu.engine.engine import InferenceEngine
 
-    cfg = LocalEngineConfig(preset="tiny-test", quant="int4",
+    cfg = LocalEngineConfig(preset="tiny-test", quant="int2",
                             max_batch_size=1, max_seq_len=64,
                             compilation_cache_dir="off")
     with pytest.raises(ValueError, match="quant"):
         InferenceEngine(cfg)
+
+
+# ---------------------------------------------------------------------------
+# int4 (W4A8) mode
+# ---------------------------------------------------------------------------
+
+def test_int4_roundtrip_error_bound():
+    """Dequantized int4 sits within half an int4 LSB per channel (levels
+    ±7 — the LSB is 127/7 ≈ 18x coarser than int8's)."""
+    rng = np.random.default_rng(4)
+    w = jnp.asarray(rng.standard_normal((32, 48)) * 3.0, jnp.float32)
+    qd = quantize_array(w, contract_axis=0, bits=4)
+    assert qd["q"].dtype == jnp.int4 and qd["s"].dtype == jnp.float32
+    deq = np.asarray(qd["q"].astype(jnp.int8), np.float32) * \
+        np.asarray(qd["s"])
+    lsb = np.asarray(qd["s"])
+    assert np.all(np.abs(deq - np.asarray(w)) <= 0.5 * lsb[None, :] + 1e-7)
+
+
+def test_int4_mm_mixed_dot_matches_dense_within_noise():
+    """mm() contracts the int4 operand directly (mixed s8xs4 dot_general);
+    result must track the fp32 matmul within W4A8 noise."""
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.standard_normal((4, 64)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((64, 32)) * 0.1, jnp.float32)
+    got = mm(x, quantize_array(w, contract_axis=0, bits=4))
+    ref = x @ w
+    # int4 noise bound: ~|x|_1 * lsb/2 per output; loose relative check.
+    err = np.abs(np.asarray(got) - np.asarray(ref))
+    assert np.median(err) < 0.12 * np.median(np.abs(np.asarray(ref)) + 1e-6)
+
+
+def test_int4_tree_keeps_lm_head_int8():
+    """quant="int4": layer matmuls go int4, lm_head (and the tied-head
+    copy) stay int8 — the logits projection decides every sampled token
+    (models/quant.py weight_bits)."""
+    from llmapigateway_tpu.models.llama import init_params
+    cfg = get_preset("tiny-test")
+    params = init_params(cfg, jax.random.PRNGKey(0), dtype=jnp.float32)
+    q = quantize_tree(params, cfg, mode="int4")
+    assert q["layers"]["wq"]["q"].dtype == jnp.int4
+    assert q["layers"]["wd"]["q"].dtype == jnp.int4
+    assert q["lm_head"]["q"].dtype == jnp.int8
+    assert not is_quantized(q["embed"])
+
+
+@pytest.mark.parametrize("preset", ["tiny-test", "tiny-qwen-test"])
+def test_engine_e2e_with_int4(preset):
+    """Engine with quant="int4" serves greedily end to end (qwen2 also
+    checks the tied-head copy stays int8)."""
+    from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
+
+    cfg = LocalEngineConfig(preset=preset, max_batch_size=2,
+                            max_seq_len=128, prefill_chunk=16,
+                            decode_burst=4, quant="int4",
+                            prewarm_sampler_variants=False,
+                            compilation_cache_dir="off")
+    engine = InferenceEngine(cfg)
+    assert engine.params["layers"]["wq"]["q"].dtype == jnp.int4
+    assert engine.stats()["quant"] == "int4"
+    if engine.model_cfg.tie_embeddings:
+        assert engine.params["lm_head_q8"]["q"].dtype == jnp.int8
+
+    async def run():
+        await engine.start()
+        req = GenRequest(prompt_ids=list(range(1, 9)), max_tokens=12,
+                         temperature=0.0)
+        await engine.submit(req)
+        async for _ in engine.stream(req):
+            pass
+        await engine.stop()
+        return req
+
+    req = asyncio.run(run())
+    assert req.finish_reason == "length"
+    assert len(req.generated) == 12
+
+
+def test_int4_checkpoint_load_quantizes_on_host(tmp_path):
+    """quant="int4" on a checkpoint engine: the preprocess hook stores
+    int4 at source precision; lm_head arrives int8."""
+    from safetensors.numpy import save_file
+    from llmapigateway_tpu.engine.engine import InferenceEngine
+
+    cfg = get_preset("tiny-test")
+    rng = np.random.default_rng(7)
+    tensors = {}
+    D, dh = cfg.d_model, cfg.head_dim
+    tensors["model.embed_tokens.weight"] = rng.standard_normal(
+        (cfg.vocab_size, D)).astype(np.float32) * 0.02
+    tensors["model.norm.weight"] = np.ones((D,), np.float32)
+    tensors["lm_head.weight"] = rng.standard_normal(
+        (cfg.vocab_size, D)).astype(np.float32) * 0.02
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        for name, shape in (
+                ("input_layernorm.weight", (D,)),
+                ("post_attention_layernorm.weight", (D,)),
+                ("self_attn.q_proj.weight", (cfg.n_heads * dh, D)),
+                ("self_attn.k_proj.weight", (cfg.n_kv_heads * dh, D)),
+                ("self_attn.v_proj.weight", (cfg.n_kv_heads * dh, D)),
+                ("self_attn.o_proj.weight", (D, cfg.n_heads * dh)),
+                ("mlp.gate_proj.weight", (cfg.d_ff, D)),
+                ("mlp.up_proj.weight", (cfg.d_ff, D)),
+                ("mlp.down_proj.weight", (D, cfg.d_ff))):
+            tensors[p + name] = (rng.standard_normal(shape) * 0.02
+                                 ).astype(np.float32)
+    save_file(tensors, str(tmp_path / "model.safetensors"))
+    import json as _json
+    (tmp_path / "config.json").write_text(_json.dumps({
+        "model_type": "llama", "vocab_size": cfg.vocab_size,
+        "hidden_size": D, "num_hidden_layers": cfg.n_layers,
+        "num_attention_heads": cfg.n_heads,
+        "num_key_value_heads": cfg.n_kv_heads,
+        "intermediate_size": cfg.d_ff}))
+
+    eng = InferenceEngine(LocalEngineConfig(
+        model_path=str(tmp_path), max_batch_size=1, max_seq_len=64,
+        prefill_chunk=16, quant="int4", prewarm_sampler_variants=False,
+        compilation_cache_dir="off"))
+    assert eng.params["layers"]["wq"]["q"].dtype == jnp.int4
+    assert eng.params["lm_head"]["q"].dtype == jnp.int8

@@ -29,7 +29,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("model_dir")
-    ap.add_argument("--quant", default="", choices=["", "int8"])
+    ap.add_argument("--quant", default="", choices=["", "int8", "int4"])
     ap.add_argument("--kv-quant", default="", choices=["", "int8"])
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--seq", type=int, default=1024)
