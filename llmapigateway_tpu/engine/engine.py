@@ -185,6 +185,8 @@ class InferenceEngine:
         if engine_cfg.kv_layout not in ("contiguous", "paged"):
             raise ValueError(f"unknown kv_layout {engine_cfg.kv_layout!r}")
         self.paged = engine_cfg.kv_layout == "paged"
+        self._swa_ring_pages = 0        # set by the paged+SWA init branch
+        self._swa_margin = 0            # in-flight burst margin, tokens
         # Sequence parallelism (SURVEY.md §5 long-context): with a `seq`
         # mesh axis, the KV cache's S dim is sharded across chips and
         # prefill runs ONE whole-prompt ring-attention program instead of
@@ -416,14 +418,39 @@ class InferenceEngine:
             page = self.cfg.kv_page_size
             per_slot = (self.S + page - 1) // page
             n_bands = self.seq_n if self.seq_n > 1 else 1
+            # Sliding-window RING reservation (single host/stage/band):
+            # the windowed kernels never read below pos − window, so a
+            # ring of O(window) physical pages serves ANY context length —
+            # ensure_mapped recycles each slot's oldest dead page onto the
+            # next logical page (mistral's rolling buffer, at page
+            # granularity). Margins: in-flight lag-one bursts may still
+            # read one burst below the current floor, and dispatch writes
+            # run one burst/chunk ahead.
+            if (c.sliding_window and self.mesh.size == 1
+                    and self.pipe_n == 1 and n_bands == 1
+                    and not self._bridge.enabled):
+                # ONE copy of the margin: _swa_rotate's recycle floor
+                # must stay in lockstep with the capacity the ring was
+                # sized for, or rotation exhausts mid-stream.
+                self._swa_margin = self.decode_burst * (self.spec_k + 1)
+                span = max(self.prefill_chunk, self._swa_margin)
+                ring = -(-(c.sliding_window + self._swa_margin + span)
+                         // page) + 2
+                if ring < per_slot:
+                    self._swa_ring_pages = ring
+                    logger.info(
+                        "paged SWA ring: %d pages/slot (window %d) instead "
+                        "of %d — steady-state KV footprint is O(window)",
+                        ring, c.sliding_window, per_slot)
             # One trash page per band (seq-sharded pools redirect masked
             # writes shard-locally).
             num_pages = self.cfg.kv_num_pages or (
                 self.B * per_slot + n_bands)
-            if num_pages - n_bands < per_slot:
+            min_hold = self._swa_ring_pages or per_slot
+            if num_pages - n_bands < min_hold:
                 raise ValueError(
-                    f"kv_num_pages={num_pages} cannot hold one max-length "
-                    f"sequence ({per_slot} pages of {page})")
+                    f"kv_num_pages={num_pages} cannot hold one "
+                    f"max-footprint sequence ({min_hold} pages of {page})")
             self.allocator = PageAllocator(num_pages, page, self.B, self.S,
                                            n_bands=n_bands)
             psh = paged_cache_sharding(
@@ -1010,7 +1037,8 @@ class InferenceEngine:
                 continue
             if self.paged:
                 total = min(len(req.prompt_ids) + req.max_tokens, self.S)
-                if not self.allocator.can_admit(total):
+                if not self.allocator.can_admit(
+                        total, ring_pages=self._swa_ring_pages):
                     break
             self._head = None
             req.slot = self._free_slots.pop()
@@ -1020,7 +1048,8 @@ class InferenceEngine:
                 # measured rate while the engine drains/idles.)
                 self._spec_ema[req.slot] = np.nan
             if self.paged:
-                self.allocator.allocate(req.slot, total)
+                self.allocator.allocate(req.slot, total,
+                                        ring_pages=self._swa_ring_pages)
                 self._table_dirty = True
             req.prefill_pos = 0
             self._running[req.slot] = req
@@ -1101,6 +1130,8 @@ class InferenceEngine:
                     dispatched = ub - len(r.prompt_ids) + 1
                     left = max(1, r.max_tokens - dispatched)
                     burst = min(burst, max(1, room), -(-left // kp1))
+                if self._swa_ring_pages:
+                    self._swa_rotate(decoding, inflight, max(1, burst) * kp1)
                 step_tokens = await asyncio.to_thread(
                     self._spec_burst, max(1, burst))
             else:
@@ -1119,6 +1150,8 @@ class InferenceEngine:
                     burst = min(burst, self.S - ub,
                                 max(1, r.max_tokens - dispatched))
                 burst = max(1, burst)
+                if self._swa_ring_pages:
+                    self._swa_rotate(decoding, inflight, burst)
                 step_tokens = await asyncio.to_thread(
                     self._decode_burst, burst)
             for tokens in step_tokens:          # in generation order
@@ -1146,6 +1179,16 @@ class InferenceEngine:
             self.lengths[slot] = 0
             self.active[slot] = False
         chunk = np.asarray(ids[pos:pos + self.prefill_chunk], np.int32)
+        if self._swa_ring_pages:
+            # Map the pages this chunk writes by recycling pages wholly
+            # below the chunk's window floor (no in-flight margin: a
+            # prefilling slot has no decode burst of its own in flight,
+            # and cross-slot bursts touch only their own table rows).
+            page = self.allocator.page_size
+            dead = max(0, pos - self.model_cfg.sliding_window + 1) // page
+            if self.allocator.ensure_mapped(
+                    slot, (pos + len(chunk) - 1) // page, dead):
+                self._table_dirty = True
         if self.fault_plan:
             self.fault_plan.on_prefill()
         self._spec_hist_chunk(slot, pos, chunk)
@@ -1584,6 +1627,24 @@ class InferenceEngine:
             host = host.copy()
             host[:, ~live] = -1
         return [host[i] for i in range(n)]
+
+    def _swa_rotate(self, decoding, inflight: int, advance: int) -> None:
+        """Sliding-window ring: before dispatching a burst, map the logical
+        pages it will write (dispatch-true lengths + worst-case advance)
+        by recycling pages wholly below the window floor minus one burst
+        of margin — an undelivered lag-one burst may still read near its
+        own, older floor. Runs on the event-loop thread (same as
+        admission), before the worker-thread dispatch reads the table."""
+        page = self.allocator.page_size
+        w = self.model_cfg.sliding_window
+        changed = False
+        for r in decoding:
+            pos = int(self.lengths[r.slot]) + inflight
+            dead = max(0, pos - self._swa_margin - w + 1) // page
+            changed |= self.allocator.ensure_mapped(
+                r.slot, (pos + advance) // page, dead)
+        if changed:
+            self._table_dirty = True
 
     def _burst_depth(self, busy: bool) -> int:
         """Depth of the next normal decode burst.

@@ -59,6 +59,11 @@ class PageAllocator:
         # (0 is band 0's trash page, never a real mapping).
         self.table = np.zeros((batch, self.pages_per_slot), np.int32)
         self._held: dict[int, list[int]] = {}
+        # Slots running the SLIDING-WINDOW RING (allocate(..., ring_pages)):
+        # they hold a fixed set of physical pages whose table mappings
+        # rotate forward as the window slides (ensure_mapped) — steady-
+        # state footprint O(window), not O(context).
+        self._ring_slots: set[int] = set()
 
     @property
     def free_pages(self) -> int:
@@ -67,12 +72,13 @@ class PageAllocator:
     def _band_of(self, logical_page: int) -> int:
         return logical_page // self.slot_band_pages
 
-    def pages_needed(self, total_tokens: int) -> int:
-        return (min(total_tokens, self.pages_per_slot * self.page_size)
+    def pages_needed(self, total_tokens: int, ring_pages: int = 0) -> int:
+        need = (min(total_tokens, self.pages_per_slot * self.page_size)
                 + self.page_size - 1) // self.page_size
+        return min(need, ring_pages) if ring_pages else need
 
-    def can_admit(self, total_tokens: int) -> bool:
-        need = self.pages_needed(total_tokens)
+    def can_admit(self, total_tokens: int, ring_pages: int = 0) -> bool:
+        need = self.pages_needed(total_tokens, ring_pages)
         if self.n_bands == 1:
             return need <= len(self._free[0])
         return all(
@@ -80,24 +86,68 @@ class PageAllocator:
             <= len(self._free[b])
             for b in range(self.n_bands))
 
-    def allocate(self, slot: int, total_tokens: int) -> bool:
-        """Reserve all pages for a slot's lifetime. False if insufficient."""
+    def allocate(self, slot: int, total_tokens: int,
+                 ring_pages: int = 0) -> bool:
+        """Reserve a slot's pages for its lifetime. False if insufficient.
+
+        ``ring_pages`` (sliding-window models, single band only): hold at
+        most that many pages — the whole-lifetime guarantee still stands
+        because :meth:`ensure_mapped` recycles the slot's own dead pages
+        instead of allocating, so the holding never grows."""
         if slot in self._held:
             raise ValueError(f"slot {slot} already holds pages")
-        need = self.pages_needed(total_tokens)
-        if not self.can_admit(total_tokens):
+        if ring_pages and self.n_bands > 1:
+            raise ValueError("ring reservation is single-band only "
+                             "(SWA × seq is rejected at engine build)")
+        need = self.pages_needed(total_tokens, ring_pages)
+        if not self.can_admit(total_tokens, ring_pages):
             return False
         pages = [self._free[self._band_of(j)].pop() for j in range(need)]
         self._held[slot] = pages
         self.table[slot, :] = 0
         self.table[slot, :need] = pages
+        if ring_pages and need < self.pages_needed(total_tokens):
+            self._ring_slots.add(slot)
         return True
+
+    def ensure_mapped(self, slot: int, last_logical: int,
+                      dead_before: int) -> bool:
+        """Ring-mode slots: extend the mapping through ``last_logical`` by
+        recycling the slot's OLDEST mapped pages, which must lie strictly
+        below ``dead_before`` (logical pages wholly below the attention
+        window's floor — the windowed kernels' index-map clamp guarantees
+        they are never read again, and the recycled page's stale contents
+        are fully overwritten as positions advance through it). Returns
+        True when the table row changed (callers flip the device-table
+        dirty bit). No-op for whole-lifetime slots."""
+        if slot not in self._ring_slots:
+            return False
+        row = self.table[slot]
+        last_logical = min(last_logical, self.pages_per_slot - 1)
+        nz = np.nonzero(row)[0]
+        hi = int(nz[-1])
+        oldest_i = 0
+        changed = False
+        for j in range(hi + 1, last_logical + 1):
+            old = int(nz[oldest_i])
+            if old >= dead_before:
+                raise RuntimeError(
+                    f"SWA page ring exhausted for slot {slot}: need logical "
+                    f"page {j} but the oldest mapping ({old}) is still "
+                    f"inside the live window (< {dead_before} required) — "
+                    f"ring sized too small for window + in-flight margin")
+            row[j] = row[old]
+            row[old] = 0
+            oldest_i += 1
+            changed = True
+        return changed
 
     def release(self, slot: int) -> None:
         pages = self._held.pop(slot, None)
         if pages:
             for j, p in enumerate(pages):
                 self._free[self._band_of(j)].append(p)
+        self._ring_slots.discard(slot)
         self.table[slot, :] = 0
 
     def check_invariants(self) -> None:
@@ -114,6 +164,12 @@ class PageAllocator:
             "page lost"
         for slot, pages in self._held.items():
             row = self.table[slot]
+            if slot in self._ring_slots:
+                # Ring rows rotate mappings forward; the held SET is the
+                # invariant, not the positions.
+                assert sorted(int(p) for p in row[row != 0]) == \
+                    sorted(pages), "ring table/holding mismatch"
+                continue
             assert list(row[:len(pages)]) == pages, "table/holding mismatch"
             assert (row[len(pages):] == 0).all()
             for j, p in enumerate(pages):

@@ -41,7 +41,12 @@ cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2, max_seq_len=96,
                         prefill_chunk=8, decode_burst=4,
                         mesh={"model": 4}, attention="reference",
                         kv_layout=KV_LAYOUT, kv_page_size=16,
-                        quant=QUANT, kv_quant=QUANT, spec_draft_len=SPEC)
+                        quant=QUANT,
+                        # int4 is weights-only; the KV cache has no int4
+                        # mode — pair it with the int8 cache (the W4A8
+                        # serving shape).
+                        kv_quant="int8" if QUANT == "int4" else QUANT,
+                        spec_draft_len=SPEC)
 engine = InferenceEngine(cfg)
 assert engine._bridge.enabled, "bridge must be active with 2 processes"
 

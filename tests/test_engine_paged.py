@@ -174,3 +174,56 @@ async def test_swa_paged_matches_contiguous_greedy(stop_engine):
     finally:
         await dense.stop()
         await paged.stop()
+
+
+def test_ring_allocator_rotation_and_invariants():
+    """SWA ring (engine/paged.py): allocate caps the holding, ensure_mapped
+    rotates the oldest dead mapping onto new logical pages, invariants
+    hold throughout, release returns the fixed set."""
+    from llmapigateway_tpu.engine.paged import PageAllocator
+    a = PageAllocator(num_pages=8, page_size=16, batch=2, max_seq=256)
+    assert a.pages_per_slot == 16           # whole-lifetime would need 16
+    assert a.allocate(0, total_tokens=256, ring_pages=4)
+    assert len(a._held[0]) == 4 and 0 in a._ring_slots
+    a.check_invariants()
+    row0 = list(a.table[0][:4])
+    # Window floor at logical 2: pages 0,1 are dead -> mapping extends to 5.
+    assert a.ensure_mapped(0, last_logical=5, dead_before=2)
+    a.check_invariants()
+    assert a.table[0][0] == 0 and a.table[0][1] == 0
+    assert list(a.table[0][2:6]) == [row0[2], row0[3], row0[0], row0[1]]
+    # Needing a page while the oldest mapping is still live must refuse.
+    import pytest as _pytest
+    with _pytest.raises(RuntimeError, match="ring exhausted"):
+        a.ensure_mapped(0, last_logical=7, dead_before=2)
+    a.release(0)
+    a.check_invariants()
+    assert a.free_pages == 7                # all non-trash pages back
+
+
+async def test_swa_ring_serves_full_context_from_small_pool(stop_engine):
+    """The capacity win: a pool far too small for whole-lifetime
+    reservation (per_slot=16 pages; usable=11) serves TWO sliding-window
+    requests to ~full context, because each slot's steady-state footprint
+    is O(window) pages. Greedy tokens still match the windowed dense
+    engine."""
+    dense = InferenceEngine(
+        LocalEngineConfig(preset="tiny-mistral-test", max_batch_size=2,
+                          max_seq_len=256, prefill_chunk=16,
+                          decode_burst=4, dtype="float32"),
+        devices=[jax.devices("cpu")[0]])
+    paged = _mk_engine(preset="tiny-mistral-test", max_batch_size=2,
+                       max_seq_len=256, prefill_chunk=16, decode_burst=4,
+                       kv_num_pages=12)
+    try:
+        assert paged._swa_ring_pages and paged._swa_ring_pages <= 5
+        prompt = "state rolls across many pages " * 4       # ~120 tokens
+        r_dense = await _generate(dense, prompt, max_tokens=96)
+        r_paged = await _generate(paged, prompt, max_tokens=96)
+        assert r_paged.generated == r_dense.generated
+        assert len(r_paged.generated) == 96
+        paged.allocator.check_invariants()
+        assert paged.allocator.free_pages == 11   # everything returned
+    finally:
+        await dense.stop()
+        await paged.stop()

@@ -97,7 +97,7 @@ def test_hf_logit_parity_with_sliding_window(tmp_path):
                                rtol=2e-3, atol=2e-3)
 
 
-async def _serve(mesh, devs, **kw):
+async def _serve(mesh, devs, max_tokens=16, **kw):
     kw.setdefault("attention", "reference")
     cfg = LocalEngineConfig(preset="tiny-mistral-test", max_batch_size=2,
                             max_seq_len=128, prefill_chunk=32,
@@ -107,7 +107,8 @@ async def _serve(mesh, devs, **kw):
     eng = InferenceEngine(cfg, devices=devs)
     rng = np.random.default_rng(6)
     prompt = list(rng.integers(2, 500, 40))      # 40 tokens >> window 16
-    req = GenRequest(prompt_ids=prompt, max_tokens=16, temperature=0.0)
+    req = GenRequest(prompt_ids=prompt, max_tokens=max_tokens,
+                     temperature=0.0)
     await eng.submit(req)
     async for _ in eng.stream(req):
         pass
@@ -166,3 +167,25 @@ def test_swa_guardrails():
             preset="tiny-mistral-test", max_batch_size=1, max_seq_len=64,
             mesh={"seq": 4}, compilation_cache_dir="off"),
             devices=cpu_devices()[:4])
+
+
+async def test_engine_swa_paged_spec_ring_matches_reference():
+    """Speculation x SWA x paged RING: the spec verify reads the window
+    from the rotating pool and data-dependent advances stay inside the
+    ring margin — greedy tokens must match the windowed dense engine
+    exactly (gate disabled so drafting really runs). The request's
+    footprint (40 + 80 = 120 tokens) EXCEEDS the ring (6 pages × 16 =
+    96 tokens), so the slot really is ring-mode and ensure_mapped
+    rotates pages mid-generation — a short request would be capped
+    under the ring and never rotate."""
+    ref, _ = await _serve({}, [cpu_devices()[0]], max_tokens=80)
+    sp, eng = await _serve({}, [cpu_devices()[0]], max_tokens=80,
+                           kv_layout="paged", kv_page_size=16,
+                           spec_draft_len=3,
+                           spec_min_tokens_per_step=0.0)
+    assert sp.generated == ref.generated and len(sp.generated) == 80
+    assert eng._swa_ring_pages > 0
+    # The footprint genuinely overflowed the ring (rotation occurred).
+    assert eng.allocator.pages_needed(120) > eng._swa_ring_pages
+    assert eng._spec_steps_done > 0
+    eng.allocator.check_invariants()

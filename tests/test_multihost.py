@@ -31,6 +31,9 @@ import pytest
     # deterministic across processes (same program + key → identical
     # int8 weights), and the quantized decode must stay bit-identical.
     ("contiguous", "int8", 0),
+    # int4 weights (W4A8 + int8 KV): the mixed s8×s4 dots and the int4
+    # sharded init must replay bit-identically on the follower too.
+    ("contiguous", "int4", 0),
     # Speculative lockstep: OP_SPEC commands, per-process hist mirrors,
     # and DATA-DEPENDENT advances derived on each host from its own
     # fetch of the same emitted matrix — over both KV layouts (paged

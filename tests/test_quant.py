@@ -529,3 +529,31 @@ def test_int4_checkpoint_load_quantizes_on_host(tmp_path):
         compilation_cache_dir="off"))
     assert eng.params["layers"]["wq"]["q"].dtype == jnp.int4
     assert eng.params["lm_head"]["q"].dtype == jnp.int8
+
+
+def test_moe_engine_e2e_with_int4():
+    """Mixtral engine with quant="int4": expert matmuls ([L,E,D,F]) store
+    int4 with per-(expert, out-channel) scales and still serve."""
+    from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
+
+    cfg = LocalEngineConfig(preset="tiny-moe-test", max_batch_size=2,
+                            max_seq_len=128, prefill_chunk=16,
+                            decode_burst=4, quant="int4",
+                            prewarm_sampler_variants=False,
+                            compilation_cache_dir="off")
+    engine = InferenceEngine(cfg)
+    assert engine.params["layers"]["wg"]["q"].dtype == jnp.int4
+    assert engine.params["layers"]["wg"]["q"].ndim == 4   # [L, E, D, F]
+
+    async def run():
+        await engine.start()
+        req = GenRequest(prompt_ids=list(range(1, 9)), max_tokens=8,
+                         temperature=0.0)
+        await engine.submit(req)
+        async for _ in engine.stream(req):
+            pass
+        await engine.stop()
+        return req
+
+    req = asyncio.run(run())
+    assert len(req.generated) == 8
