@@ -685,14 +685,6 @@ class InferenceEngine:
                             "— Pallas kernels need a full-extent local "
                             "cache)")
             return "reference"
-        if self.model_cfg.sliding_window and self.mesh.size > 1:
-            # Single-device SWA runs the windowed flash kernels; the
-            # shard_map wrapper doesn't thread the window yet (v1).
-            if impl == "pallas":
-                logger.warning("attention=pallas does not carry the "
-                               "sliding-window bound on a multi-chip mesh "
-                               "(v1); using the windowed dense reference")
-            return "reference"
         if impl == "auto":
             return "pallas" if jax.default_backend() == "tpu" else "reference"
         return impl
@@ -871,16 +863,19 @@ class InferenceEngine:
         None: llama.forward's default dense jnp path)."""
         impl = self._resolve_attention_impl()
         if impl == "pallas":
+            w = self.model_cfg.sliding_window
             if self.mesh.size > 1:
                 # Sharded cache → the kernels must run under shard_map
                 # (pallas_call has no GSPMD partitioning rule). The
-                # wrapper's per-leaf specs cover int8 {"q","s"} caches.
+                # wrapper's per-leaf specs cover int8 {"q","s"} caches;
+                # the sliding-window bound threads through (positions are
+                # absolute — batch/head sharding doesn't touch them).
                 from ..ops import make_sharded_cache_attention_fn
                 logger.info("attention: pallas flash kernels (shard_map over "
-                            "%s)", dict(self.mesh.shape))
-                return make_sharded_cache_attention_fn(self.mesh)
+                            "%s)%s", dict(self.mesh.shape),
+                            f" (sliding window {w})" if w else "")
+                return make_sharded_cache_attention_fn(self.mesh, window=w)
             from ..ops import make_cache_attention_fn
-            w = self.model_cfg.sliding_window
             logger.info("attention: pallas flash kernels%s",
                         f" (sliding window {w})" if w else "")
             return make_cache_attention_fn(window=w)

@@ -439,7 +439,8 @@ def make_cache_attention_fn(block_s: int | None = None,
 
 def make_sharded_cache_attention_fn(mesh, block_s: int | None = None,
                                     block_t: int | None = None,
-                                    interpret: bool | None = None):
+                                    interpret: bool | None = None,
+                                    window: int = 0):
     """Mesh-aware ``attention_fn``: the flash kernels under ``shard_map``.
 
     ``pallas_call`` has no GSPMD partitioning rule, so invoking the kernels
@@ -453,7 +454,10 @@ def make_sharded_cache_attention_fn(mesh, block_s: int | None = None,
     """
     from jax.sharding import PartitionSpec as P
 
-    base = make_cache_attention_fn(block_s, block_t, interpret)
+    # The window bound threads straight through: positions are absolute
+    # per slot, untouched by batch (data) or head (model) sharding.
+    base = make_cache_attention_fn(block_s, block_t, interpret,
+                                   window=window)
 
     def _axes(q, layer_k):
         B, _, H, _ = q.shape

@@ -189,3 +189,16 @@ async def test_engine_swa_paged_spec_ring_matches_reference():
     assert eng.allocator.pages_needed(120) > eng._swa_ring_pages
     assert eng._spec_steps_done > 0
     eng.allocator.check_invariants()
+
+
+async def test_engine_swa_sharded_pallas_matches_reference():
+    """SWA on a MULTI-CHIP mesh with the pallas kernels: the window bound
+    threads through the shard_map'd flash wrapper (head sharding on TP,
+    batch on DP never touch absolute positions) — greedy tokens must
+    match the windowed dense reference engine."""
+    ref, _ = await _serve({}, [cpu_devices()[0]])
+    tp, eng = await _serve({"model": 2}, cpu_devices()[:2],
+                           attention="pallas")
+    assert tp.generated == ref.generated
+    assert eng.model_cfg.sliding_window == 16 and eng.mesh.size == 2
+    assert eng._resolve_attention_impl() == "pallas"
