@@ -47,6 +47,7 @@ Usage: python bench.py [--kv both] [--batch 8] [--steps 200] [--skip-ttft]
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import subprocess
@@ -181,8 +182,9 @@ def build_engine(args, kv_layout: str, preset: str | None = None,
         decode_burst=burst or args.burst, kv_layout=kv_layout,
         ttft_target_ms=ttft_target,
         # Paged: the page IS the paged kernel's DMA block, so page
-        # geometry sets its DMA efficiency — and its optimum (128) is NOT
-        # the dense kernel's (256); see the paged_sweep phase.
+        # geometry sets its DMA efficiency; the paged_sweep phase
+        # re-measures 128-vs-256 every run so the default tracks the
+        # hardware (2026-07-31 v5e ladder: 256 wins, 1647.8 vs 1443.7).
         kv_page_size=args.page_size,
         # The off-thread sampler pre-compile would churn CPU during the
         # TTFT probes; the bench measures the greedy path only.
@@ -1076,11 +1078,18 @@ def main() -> None:
             largs = argparse.Namespace(**vars(args))
             largs.seq, largs.prompt_len, largs.batch = (
                 args.long_seq, args.long_prompt, args.long_batch)
+            # The preset's max_seq_len (tinyllama: 2048) would clamp
+            # engine.S below prompt+decode at these shapes; random-weight
+            # perf doesn't care about trained RoPE range, so lift it.
+            from llmapigateway_tpu.models.config import get_preset
+            lmc = dataclasses.replace(get_preset(args.preset),
+                                      max_seq_len=args.long_seq)
             lc = {}
             engine = None
             for label, kvq in (("bf16", ""), ("kv8", "int8")):
                 engine = None
-                engine, _ = build_engine(largs, "contiguous", kv_quant=kvq)
+                engine, _ = build_engine(largs, "contiguous", kv_quant=kvq,
+                                         model_cfg=lmc)
                 r = fill_and_time_decode(engine, largs,
                                          steps=args.long_steps)
                 lc[label] = {"tok_s": r["tok_s"],
@@ -1108,7 +1117,6 @@ def main() -> None:
     # one chip at the context where the window matters.
     if args.swa and not over_budget("swa"):
         try:
-            import dataclasses
             from llmapigateway_tpu.models.config import get_preset
             sargs = argparse.Namespace(**vars(args))
             sargs.seq, sargs.prompt_len, sargs.batch = (

@@ -36,11 +36,13 @@ class LocalEngineConfig(BaseModel):
     max_batch_size: int = 8
     max_seq_len: int = 4096
     kv_layout: str = "contiguous"   # "contiguous" | "paged"
-    # Page size doubles as the paged kernel's DMA block; 128 is the
-    # PAGED kernel's measured optimum on v5e (1500.5 vs 1322.3 tok/s at
-    # 256, TinyLlama bs=8). The dense kernel's 256-block optimum does
-    # not transfer to the paged kernel (bench.py paged_sweep).
-    kv_page_size: int = 128
+    # Page size doubles as the paged kernel's DMA block; 256 is the
+    # measured optimum on v5e (2026-07-31 ladder: 1647.8 vs 1443.7
+    # tok/s at 128, TinyLlama bs=8 — bench.py's paged_sweep re-measures
+    # both every run so this default tracks the hardware). Smaller pages
+    # trade a little DMA efficiency for finer capacity granularity in
+    # the equal-HBM admission math (engine/paged.py).
+    kv_page_size: int = 256
     kv_num_pages: int = 0           # 0 → derived from max_batch_size*max_seq_len
     prefill_chunk: int = 512
     decode_burst: int = 8           # chained decode steps per host sync
@@ -81,11 +83,21 @@ class LocalEngineConfig(BaseModel):
     # enabled in config without taxing non-repetitive traffic. While
     # gated off, one 1-step speculative PROBE runs every
     # `spec_probe_interval` decode rounds to re-measure (text often turns
-    # repetitive mid-stream: quoting, code, lists). 0 disables the gate
-    # (always draft). New/unmeasured slots count optimistically so fresh
-    # requests get a chance to establish their rate.
+    # repetitive mid-stream: quoting, code, lists). 0 disables the
+    # ACCEPTANCE term only — the wall-clock term below still gates
+    # unless spec_wall_gate is also off (both off = always draft).
+    # New/unmeasured slots count optimistically so fresh requests get a
+    # chance to establish their rate.
     spec_min_tokens_per_step: float = 1.2
     spec_probe_interval: int = 25
+    # Wall-clock gate term: also close the gate while the MEASURED spec
+    # ms-per-emitted-token (EMA over full spec bursts) exceeds the normal
+    # path's. Acceptance tokens/step alone can hold a net-loss gate open
+    # — a degenerate repetition loop accepts 2+ tokens/step while each
+    # spec step costs several times a fused decode step (v5e ladder
+    # 2026-07-31: 346.9 vs 1475.1 tok/s, acceptance gate open at 2.24).
+    # Off = acceptance-only gating (the pre-r5 behavior).
+    spec_wall_gate: bool = True
     # Weight quantization: "int8" stores the seven big matmul weights per
     # layer (incl. MoE expert matmuls) + lm_head as symmetric per-channel
     # int8 (activations quantize dynamically inside the step;

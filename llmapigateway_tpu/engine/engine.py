@@ -516,10 +516,32 @@ class InferenceEngine:
         # burst's token must not clobber the new request's first token).
         self._pending: tuple | None = None
         self._slot_epoch = np.zeros((self.B,), np.int64)
-        # Rolling decode-rate gauge for /v1/api/engine-stats (EMA over
-        # full-size bursts; ms per decode step including scheduler-side
-        # overhead — the number an operator compares against the bench).
-        self._ema_step_ms: float | None = None
+        # Step-time model for the ttft_target_ms burst-depth cap. A
+        # burst's wall time is C + d·step (C = per-burst fixed cost —
+        # host scheduling plus, on a tunneled chip, the dispatch round
+        # trip), so the naive wall/d estimate overstates the per-step
+        # time at shallow depths; feeding it back into the cap shallowed
+        # the bursts further — a death spiral to the minimum compiled
+        # depth (observed on v5e: 372 tok/s vs 1468 at a fixed burst 16,
+        # same TTFT target). Instead, keep an EMA of burst WALL per
+        # depth (any steady same-depth pair — busy stretches at
+        # decode_burst_busy feed this too, so it never goes stale under
+        # load) and fit step = Δwall/Δdepth across the two largest
+        # measured depths: C cancels, the estimate is depth-unbiased,
+        # and the control loop is self-correcting in both directions
+        # (see _step_ms_estimate). No dedicated refresh bursts needed.
+        # Entries age: a depth that stopped running (e.g. the cap
+        # settled shallower) holds a wall measured under OLD conditions
+        # (shorter contexts); fitting against it would bias the slope —
+        # _step_ms_estimate ignores entries not refreshed within the
+        # last _BURST_WALL_WINDOW samples (falling back to the newest).
+        self._burst_walls: dict[int, float] = {}
+        self._burst_wall_stamp: dict[int, int] = {}
+        self._burst_wall_n = 0
+        # Operator-facing gauge for /v1/api/engine-stats: EMA over ANY
+        # steady same-depth burst (wall/depth, per-burst overhead
+        # included) — the number an operator compares to the bench.
+        self._ema_step_ms_stats: float | None = None
         # Speculative decoding state: host token-history mirror (device
         # twin rides the dirty upload) + acceptance counters.
         if self.spec_k:
@@ -538,6 +560,37 @@ class InferenceEngine:
                 1, self.cfg.spec_probe_interval)
             self._spec_ema = np.full((self.B,), np.nan)
             self._spec_probe_ctr = 0
+            # Wall-clock gate term: EMA of measured ms per emitted token
+            # across full spec bursts. Acceptance alone can lie — a
+            # random-weight repetition loop accepts 2+ tokens/step while
+            # each spec step (host draft + k+1-wide verify + its own
+            # dispatch pattern) costs many times a fused decode step
+            # (v5e ladder 2026-07-31: spec_mixed 346.9 vs 1475.1 tok/s
+            # with the acceptance gate OPEN at ema 2.24). None = not yet
+            # measured; _spec_wall_age forces a periodic re-measure so a
+            # wall-closed gate isn't pinned shut on stale data.
+            self._spec_ms_per_tok: float | None = None
+            self._spec_wall_age = 0
+            self._spec_wall_gate_on = bool(self.cfg.spec_wall_gate)
+            # Baseline probe: spec-open traffic never runs NORMAL decode
+            # bursts, so the step-time model the wall gate compares
+            # against would never get a sample on an engine that is
+            # spec-open from its first request. Every
+            # 8*spec_probe_interval spec rounds (or immediately while no
+            # baseline exists), run TWO consecutive normal rounds — two,
+            # because a steady same-depth PAIR is what lands a wall
+            # sample (the first normal burst after a spec burst is a
+            # transition and can't be timed).
+            self._spec_base_ctr = 0
+            self._spec_base_rounds = 0
+            # Starvation guard: some workloads can never land a wall
+            # sample (every normal burst capped below the smallest
+            # compiled rung -> synchronous path -> no steady pair).
+            # After this many fruitless baseline attempts, stop forcing
+            # normal rounds — the wall gate simply stays inert (no
+            # baseline) and the acceptance gate still protects, instead
+            # of pinning speculation off forever.
+            self._spec_base_fails = 0
 
     def _compile(self) -> None:
         if self.paged:
@@ -1084,22 +1137,67 @@ class InferenceEngine:
             # spec_probe_interval rounds — so enabling speculation in
             # config is safe for non-repetitive traffic.
             spec_probe = False
-            if spec_now and self.spec_min_tps > 0:
-                ema = self._spec_ema[[r.slot for r in decoding]]
+            if spec_now and self._spec_wall_gate_on \
+                    and not self._bridge.enabled:
+                # Baseline probe: the wall gate needs a NORMAL-path step
+                # time to compare against, and spec-open traffic never
+                # runs normal bursts. Two consecutive normal rounds (a
+                # steady same-depth pair is what lands a wall sample),
+                # immediately while no baseline exists, then refreshed
+                # every 8*spec_probe_interval spec rounds. Multihost is
+                # excluded: its bursts run synchronously through the
+                # bridge (no lag-one walls are ever sampled), so the
+                # wall gate is inert there and the probe would pin
+                # spec_now=False forever on a never-measured baseline.
+                if self._spec_base_rounds > 0:
+                    self._spec_base_rounds -= 1
+                    spec_now = False
+                else:
+                    est = self._step_ms_estimate()
+                    if est is not None:
+                        self._spec_base_fails = 0
+                    self._spec_base_ctr += 1
+                    if ((est is None and self._spec_base_fails < 4)
+                            or self._spec_base_ctr
+                            >= 8 * self.spec_probe_interval):
+                        self._spec_base_ctr = 0
+                        if est is None:
+                            self._spec_base_fails += 1
+                        self._spec_base_rounds = 1
+                        spec_now = False
+            if spec_now and (self.spec_min_tps > 0
+                             or self._spec_wall_gate_on):
                 # A batch with NO measured slots always drafts — the burst
                 # IS the measurement. Unmeasured slots in a mixed batch
                 # count optimistically (k+1) so fresh requests can re-open
-                # the gate; one low burst closes it again.
-                if not np.all(np.isnan(ema)):
-                    mean_tps = float(np.mean(np.where(
-                        np.isnan(ema), self.spec_k + 1, ema)))
-                    if mean_tps < self.spec_min_tps:
-                        self._spec_probe_ctr += 1
-                        if self._spec_probe_ctr >= self.spec_probe_interval:
-                            self._spec_probe_ctr = 0
-                            spec_probe = True        # 1-step re-measure
-                        else:
-                            spec_now = False
+                # the gate; one low burst closes it again. The wall-clock
+                # term applies even with the acceptance threshold
+                # disabled (spec_min_tokens_per_step=0): each protects
+                # against a different failure mode.
+                below = False
+                if self.spec_min_tps > 0:
+                    ema = self._spec_ema[[r.slot for r in decoding]]
+                    if not np.all(np.isnan(ema)):
+                        mean_tps = float(np.mean(np.where(
+                            np.isnan(ema), self.spec_k + 1, ema)))
+                        below = mean_tps < self.spec_min_tps
+                if below or self._spec_wall_loses():
+                    self._spec_probe_ctr += 1
+                    if self._spec_probe_ctr >= self.spec_probe_interval:
+                        self._spec_probe_ctr = 0
+                        spec_probe = True            # 1-step re-measure
+                        # A probe re-measures ACCEPTANCE only. If the
+                        # close was wall-clock, drop the wall gauge every
+                        # few probe cycles so one full burst can re-time
+                        # it under current conditions (bounded tax: one
+                        # possibly-slow burst per 4 probe intervals).
+                        self._spec_wall_age += 1
+                        if (self._spec_ms_per_tok is not None
+                                and self._spec_wall_age >= 4):
+                            self._spec_wall_age = 0
+                            self._spec_ms_per_tok = None
+                    else:
+                        spec_now = False
             # While a spec burst is in flight (lag-one), the host lengths
             # lag dispatch by a data-dependent amount — cap against the
             # worst case (every in-flight step fully accepted).
@@ -1433,6 +1531,7 @@ class InferenceEngine:
 
         table = (self._device_table(),) if self.paged else ()
         if n_steps == self._spec_scan_len:
+            t0 = time.monotonic()
             emitted, self.cache, self._d_hist, self._d_tokens, \
                 self._d_lengths = self._spec_scan(
                     self.params, self.cache, *table, self._d_hist,
@@ -1444,7 +1543,20 @@ class InferenceEngine:
             prev, self._spec_pending = self._spec_pending, (
                 emitted, n_steps, self.active.copy(),
                 self._slot_epoch.copy())
-            return pre + self._flush_spec_entry(prev)
+            before = self._spec_tokens_out
+            out = pre + self._flush_spec_entry(prev)
+            if prev is not None and prev[1] == n_steps:
+                # Steady state at full spec depth: this call's wall time
+                # covers one same-depth burst (lag-one), and the flushed
+                # burst's emitted count is its token yield — feed the
+                # wall-clock gate gauge (see _spec_wall_loses).
+                toks = self._spec_tokens_out - before
+                if toks > 0:
+                    ms = 1000.0 * (time.monotonic() - t0) / toks
+                    self._spec_ms_per_tok = (
+                        ms if self._spec_ms_per_tok is None else
+                        0.7 * self._spec_ms_per_tok + 0.3 * ms)
+            return out
 
         # Partial bursts (cache/budget caps, busy depth 1) stay
         # synchronous: land the in-flight burst, then step one at a time.
@@ -1516,6 +1628,64 @@ class InferenceEngine:
                     self._d_tokens, self._d_lengths, self._d_active)
             outs.append(em)
         return np.stack([np.asarray(e) for e in outs])
+
+    def _spec_wall_loses(self) -> bool:
+        """True when the measured spec wall-clock (ms per emitted token,
+        EMA over full spec bursts) exceeds the normal path's (the stats
+        step gauge is wall per step; every active slot advances one token
+        per step). Acceptance tokens/step alone is not a profit signal:
+        it ignores what the spec step itself costs, which on a tunneled
+        chip (and any regime where the k+1-wide verify doesn't amortize)
+        can dwarf the accepted-token win."""
+        if not self._spec_wall_gate_on or self._spec_ms_per_tok is None:
+            return False
+        # Like-for-like baseline: the fitted per-step time (per-burst
+        # fixed cost removed) — an amortized shallow-burst wall/d would
+        # inflate the normal-path baseline and hold a net-loss spec open
+        # under sustained busy traffic.
+        base = self._step_ms_estimate()
+        if base is None:
+            return False
+        n = max(1, int(self.active.sum()))
+        return self._spec_ms_per_tok > base / n
+
+    def _step_ms_estimate(self) -> float | None:
+        """Per-decode-step ms from the per-depth burst-wall EMAs.
+
+        wall(d) = C + d·step, so with two measured depths the slope
+        Δwall/Δdepth is the fixed-cost-free step time (use the two
+        LARGEST depths — widest Δ, best signal). With one depth, fall
+        back to wall/d — an OVERestimate (C folded in), which errs the
+        ttft cap toward shallower bursts (TTFT-safe), and is corrected
+        as soon as a second depth is measured. The estimate is clamped
+        to (0, min(wall/d)]: the slope can't exceed any amortized wall,
+        and noise-negative slopes fall back to the conservative bound.
+        Only entries refreshed within the last ``_BURST_WALL_WINDOW``
+        samples participate: a depth that stopped running holds a wall
+        measured under old conditions (shorter contexts, lighter
+        batch), and a fit against it would bias the step time — if all
+        are stale, only the most recent entry is used."""
+        w = self._burst_walls
+        if not w:
+            return None
+        stamp = self._burst_wall_stamp
+        fresh = {d: ms for d, ms in w.items()
+                 if self._burst_wall_n - stamp.get(d, self._burst_wall_n)
+                 <= self._BURST_WALL_WINDOW}
+        if not fresh:
+            d = max(w, key=lambda k: stamp.get(k, 0))
+            fresh = {d: w[d]}
+        w = fresh
+        ub = min(ms / d for d, ms in w.items())
+        if len(w) == 1:
+            return ub
+        d1, d2 = sorted(w)[-2:]
+        step = (w[d2] - w[d1]) / (d2 - d1)
+        if step <= 0:
+            return ub
+        return min(step, ub)
+
+    _BURST_WALL_WINDOW = 512
 
     def _spec_inflight_advance(self) -> int:
         """Upper bound on cache positions an in-flight speculative burst
@@ -1649,20 +1819,23 @@ class InferenceEngine:
         set: an arriving probe cannot preempt the scan already dispatched,
         so its TTFT floor is in-flight depth × step time plus the flush +
         prefill chunk that follow admission — cap the deep depth so the
-        exposure spends at most HALF the target, sized by the engine's own
-        steady-state step-time gauge (``_ema_step_ms``). The cap snaps
-        DOWN to a compiled scan depth (``_burst_depths``): an arbitrary
+        exposure spends at most HALF the target, sized by the engine's
+        own fitted step time (``_step_ms_estimate``: Δwall/Δdepth, so
+        per-burst fixed cost doesn't bias the cap). The cap snaps DOWN
+        to a compiled scan depth (``_burst_depths``): an arbitrary
         depth would fall off the fused-scan fast path onto per-step
-        dispatch. Until the gauge has a sample, run the configured depth —
-        the first bursts are the measurement."""
+        dispatch. Until the model has a sample, run the configured
+        depth — the first bursts are the measurement."""
         if busy:
             return self.decode_burst_busy
-        if self.ttft_target_ms > 0 and self._ema_step_ms:
-            cap = 0.5 * self.ttft_target_ms / self._ema_step_ms
-            fitting = [d for d in self._burst_depths if d <= cap]
-            if fitting:
-                return min(max(fitting), self.decode_burst)
-            return self._burst_depths[0]
+        if self.ttft_target_ms > 0:
+            est = self._step_ms_estimate()
+            if est:
+                cap = 0.5 * self.ttft_target_ms / est
+                fitting = [d for d in self._burst_depths if d <= cap]
+                if fitting:
+                    return min(max(fitting), self.decode_burst)
+                return self._burst_depths[0]
         return self.decode_burst
 
     def _decode_burst(self, n_steps: int) -> list[np.ndarray]:
@@ -1756,14 +1929,23 @@ class InferenceEngine:
                 self._d_hist_fresh = False
             out = pre + self._flush_entry(prev)
             if prev is not None and prev[1] == n_steps:
-                # Steady state at a constant depth: this call's wall time
-                # covers exactly one same-depth burst. Depth transitions
-                # (busy<->idle) are skipped — dividing the previous deep
-                # burst's wait by the new shallow depth would feed ~4x-off
-                # samples into the gauge.
-                ms = 1000.0 * (time.monotonic() - t0) / n_steps
-                self._ema_step_ms = ms if self._ema_step_ms is None else \
-                    0.8 * self._ema_step_ms + 0.2 * ms
+                # Steady same-depth pair: this call's wall time covers
+                # exactly one burst at this depth (lag-one). Depth
+                # transitions (busy<->idle) are excluded — the previous
+                # burst's wait divided by the new depth would feed
+                # ~4x-off samples. Feeds BOTH the per-depth wall model
+                # (_step_ms_estimate — the ttft cap's input) and the
+                # operator stats gauge.
+                wall = 1000.0 * (time.monotonic() - t0)
+                prev_w = self._burst_walls.get(n_steps)
+                self._burst_walls[n_steps] = (
+                    wall if prev_w is None else 0.8 * prev_w + 0.2 * wall)
+                self._burst_wall_n += 1
+                self._burst_wall_stamp[n_steps] = self._burst_wall_n
+                ms_any = wall / n_steps
+                self._ema_step_ms_stats = (
+                    ms_any if self._ema_step_ms_stats is None else
+                    0.8 * self._ema_step_ms_stats + 0.2 * ms_any)
             return out
 
         # Synchronous path: flush any in-flight burst first so tokens are
@@ -1897,33 +2079,42 @@ class InferenceEngine:
             out["total_pages"] = (self.allocator.num_pages
                                   - self.allocator.n_bands)
             out["page_size"] = self.allocator.page_size
-        if self._ema_step_ms is not None:
-            out["decode_ms_per_step"] = round(self._ema_step_ms, 3)
+        gauge = (self._ema_step_ms_stats
+                 if self._ema_step_ms_stats is not None
+                 else self._step_ms_estimate())
+        if gauge is not None:
+            out["decode_ms_per_step"] = round(gauge, 3)
             active_n = int(self.active.sum())
             if active_n:
-                out["decode_tok_s"] = round(
-                    1000.0 * active_n / self._ema_step_ms, 1)
+                out["decode_tok_s"] = round(1000.0 * active_n / gauge, 1)
         if self.spec_k:
             out["spec_draft_len"] = self.spec_k
             if self._spec_steps_done:
                 out["spec_tokens_per_step"] = round(
                     self._spec_tokens_out / self._spec_steps_done, 2)
-            if self.spec_min_tps > 0:
+            if self.spec_min_tps > 0 or self._spec_wall_gate_on:
                 # Live view of the adaptive gate: mean measured acceptance
                 # (active slots when serving, else the last measured
-                # rates) and whether drafting currently pays.
+                # rates) and whether drafting currently pays. The wall
+                # term reports even with the acceptance threshold
+                # disabled (spec_min_tokens_per_step=0).
                 act = self._spec_ema[self.active]
                 basis = act if act.size else self._spec_ema
                 known = basis[~np.isnan(basis)]
+                accept_ok = True
                 if known.size:
                     out["spec_ema_tokens_per_step"] = round(
                         float(known.mean()), 2)
-                    out["spec_gate_open"] = bool(
-                        float(np.mean(np.where(np.isnan(basis),
-                                               self.spec_k + 1, basis)))
-                        >= self.spec_min_tps)
-                else:       # nothing measured yet → the next burst drafts
-                    out["spec_gate_open"] = True
+                    if self.spec_min_tps > 0:
+                        accept_ok = bool(
+                            float(np.mean(np.where(np.isnan(basis),
+                                                   self.spec_k + 1, basis)))
+                            >= self.spec_min_tps)
+                out["spec_gate_open"] = (accept_ok
+                                         and not self._spec_wall_loses())
+                if self._spec_ms_per_tok is not None:
+                    out["spec_ms_per_token"] = round(
+                        self._spec_ms_per_tok, 3)
         return out
 
 

@@ -80,7 +80,12 @@ def attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask,
     kernel here and in ops/paged_attention.py (dense/paged × decode/prefill
     × bf16/int8-KV). ``mask(scores)`` applies the caller's visibility rule;
     ``ks_ref``/``vs_ref`` are the optional int8-KV per-token scale blocks
-    ``[1, BS]``: the scale factors out of the Dh contraction, so scores
+    ``[1, 1, 1, BS]`` (rank-4: the unit dim before the token axis keeps the
+    block's trailing two dims ``(1, BS)`` legal under the TPU (8, 128)
+    tiling rule — a ``(1, BS)`` block of a rank-3 ``[B, KV, S]`` array
+    would put a block of 1 on the KV dim, which real Mosaic lowering
+    rejects; interpret mode never catches this): the scale factors out of
+    the Dh contraction, so scores
     multiply by ``ks`` after the QK dot and probs by ``vs`` before the PV
     dot (after ``l`` accumulates — the softmax denominator is unscaled),
     and no dequantized [BS, Dh] block is ever built."""
@@ -92,7 +97,7 @@ def attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask,
         preferred_element_type=jnp.float32)        # [rows, BS]
     scores *= q.shape[-1] ** -0.5
     if ks_ref is not None:
-        scores = scores * ks_ref[0]
+        scores = scores * ks_ref[0, 0]
     scores = mask(scores)
 
     m_prev = m_ref[:, :1]
@@ -100,7 +105,7 @@ def attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask,
     alpha = jnp.exp(m_prev - m_new)
     e = jnp.exp(scores - m_new)                    # [rows, BS]
     l_ref[:, :1] = alpha * l_ref[:, :1] + jnp.sum(e, axis=1, keepdims=True)
-    p = e if vs_ref is None else e * vs_ref[0]
+    p = e if vs_ref is None else e * vs_ref[0, 0]
     acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
         p, v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)        # [rows, Dh]
@@ -205,12 +210,17 @@ def flash_decode_attention(q: jax.Array, k_new: jax.Array,
 
     def scale_index(b, h, s, nv):
         first, last = _live_range(nv[b])
-        return b, h, jnp.clip(s, first, last)
+        return b, h, 0, jnp.clip(s, first, last)
 
+    # Scales ride as rank-4 [B, KV, 1, S] so the block's trailing dims are
+    # (1, block_s) — legal under the TPU (8, 128) tiling rule for any KV
+    # (a (1, block_s) block of the stored [B, KV, S] would block the KV
+    # dim at 1, which real Mosaic lowering rejects; see attend_block).
     kv_spec = pl.BlockSpec((1, 1, block_s, Dh), kv_index)
-    s_spec = pl.BlockSpec((1, 1, block_s), scale_index)
+    s_spec = pl.BlockSpec((1, 1, 1, block_s), scale_index)
     if quant:
-        kv_operands = (layer_k["q"], layer_k["s"], layer_v["q"], layer_v["s"])
+        kv_operands = (layer_k["q"], layer_k["s"][:, :, None, :],
+                       layer_v["q"], layer_v["s"][:, :, None, :])
         kv_specs = [kv_spec, s_spec, kv_spec, s_spec]
     else:
         kv_operands = (layer_k, layer_v)
@@ -341,12 +351,14 @@ def flash_prefill_attention(q: jax.Array, layer_k, layer_v,
 
     def scale_index(b, h, t, s, st):
         first, last = _live_range(st[b], t)
-        return b, h // G, jnp.clip(s, first, last)
+        return b, h // G, 0, jnp.clip(s, first, last)
 
+    # Rank-4 [B, KV, 1, S] scale layout — see flash_decode_attention.
     kv_spec = pl.BlockSpec((1, 1, block_s, Dh), kv_index)
-    s_spec = pl.BlockSpec((1, 1, block_s), scale_index)
+    s_spec = pl.BlockSpec((1, 1, 1, block_s), scale_index)
     if quant:
-        kv_operands = (layer_k["q"], layer_k["s"], layer_v["q"], layer_v["s"])
+        kv_operands = (layer_k["q"], layer_k["s"][:, :, None, :],
+                       layer_v["q"], layer_v["s"][:, :, None, :])
         kv_specs = [kv_spec, s_spec, kv_spec, s_spec]
     else:
         kv_operands = (layer_k, layer_v)

@@ -263,13 +263,16 @@ def paged_decode_attention(q: jax.Array, k_new: jax.Array,
 
     def scale_index(b, h, j, pt, nv):
         first, last = _live_range(nv[b])
-        return pt[b, jnp.clip(j, first, last)], h, 0
+        return pt[b, jnp.clip(j, first, last)], h, 0, 0
 
+    # Scales ride as rank-4 [P, KV, 1, page] so the block's trailing dims
+    # are (1, page) — legal under the TPU (8, 128) tiling rule for any KV
+    # (see flash_attention.attend_block).
     kv_spec = pl.BlockSpec((1, 1, page, Dh), kv_index)
-    s_spec = pl.BlockSpec((1, 1, page), scale_index)
+    s_spec = pl.BlockSpec((1, 1, 1, page), scale_index)
     if quant:
-        kv_operands = (k_pages["q"], k_pages["s"],
-                       v_pages["q"], v_pages["s"])
+        kv_operands = (k_pages["q"], k_pages["s"][:, :, None, :],
+                       v_pages["q"], v_pages["s"][:, :, None, :])
         kv_specs = [kv_spec, s_spec, kv_spec, s_spec]
     else:
         kv_operands = (k_pages, v_pages)
@@ -397,13 +400,14 @@ def paged_prefill_attention(q: jax.Array, k_pages, v_pages,
 
     def scale_index(b, h, t, j, pt, st):
         first, last = _live_range(st[b], t)
-        return pt[b, jnp.clip(j, first, last)], h // G, 0
+        return pt[b, jnp.clip(j, first, last)], h // G, 0, 0
 
+    # Rank-4 [P, KV, 1, page] scale layout — see paged_decode_attention.
     kv_spec = pl.BlockSpec((1, 1, page, Dh), kv_index)
-    s_spec = pl.BlockSpec((1, 1, page), scale_index)
+    s_spec = pl.BlockSpec((1, 1, 1, page), scale_index)
     if quant:
-        kv_operands = (k_pages["q"], k_pages["s"],
-                       v_pages["q"], v_pages["s"])
+        kv_operands = (k_pages["q"], k_pages["s"][:, :, None, :],
+                       v_pages["q"], v_pages["s"][:, :, None, :])
         kv_specs = [kv_spec, s_spec, kv_spec, s_spec]
     else:
         kv_operands = (k_pages, v_pages)

@@ -292,8 +292,8 @@ async def test_ttft_under_load_first_token_within_bounded_steps():
 
 def test_ttft_target_caps_idle_burst_depth():
     """With ttft_target_ms set, the idle-queue deep burst depth is capped
-    by the engine's own step-time gauge (half the target), snapping DOWN
-    to a compiled scan depth; busy depth and the no-gauge warmup are
+    by the engine's fitted step time (half the target), snapping DOWN
+    to a compiled scan depth; busy depth and the no-model warmup are
     unaffected. (VERDICT r4 item 2: TTFT exposure is the in-flight
     burst — a fixed deep depth is only right for one step time.)"""
     cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
@@ -303,21 +303,104 @@ def test_ttft_target_caps_idle_burst_depth():
     eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
     # The half-deep rung is compiled alongside deep and busy.
     assert set(eng._burst_depths) == {4, 16, 32}
-    # No gauge yet: run configured depth (the first bursts measure it).
+    # No samples yet: run configured depth (the first bursts measure it).
     assert eng._burst_depth(busy=False) == 32
     assert eng._burst_depth(busy=True) == 4
     # 2 ms/step -> 50 ms budget -> cap 25 -> snaps down to the 16 rung.
-    eng._ema_step_ms = 2.0
+    eng._burst_walls = {32: 64.0}
     assert eng._burst_depth(busy=False) == 16
     # Fast steps: full depth fits the budget.
-    eng._ema_step_ms = 1.0
+    eng._burst_walls = {32: 32.0}
     assert eng._burst_depth(busy=False) == 32
     # Slow steps: even the busy depth overruns -> shallowest rung.
-    eng._ema_step_ms = 40.0
+    eng._burst_walls = {32: 1280.0}
     assert eng._burst_depth(busy=False) == 4
     # Busy path ignores the target entirely.
-    eng._ema_step_ms = 2.0
+    eng._burst_walls = {32: 64.0}
     assert eng._burst_depth(busy=True) == 4
+
+
+def test_step_time_fit_removes_per_burst_fixed_cost():
+    """The cap's step-time estimate is the Δwall/Δdepth slope across the
+    two largest measured depths, so per-burst fixed cost C cancels. The
+    naive wall/d estimate folds C into the step time, which shrinks the
+    cap, which shallows the bursts, which inflates the estimate further —
+    a death spiral to the minimum compiled depth (observed on v5e:
+    372 tok/s through the scheduler vs 1468 at a fixed burst 16, same
+    TTFT target). The fit makes the loop self-correcting: shallow-depth
+    samples plus ANY second depth recover the true step time."""
+    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+                            max_seq_len=64, prefill_chunk=16,
+                            dtype="float32", decode_burst=32,
+                            decode_burst_busy=4, ttft_target_ms=100.0)
+    eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
+    # True step 2 ms, fixed cost 40 ms/burst. One shallow depth alone:
+    # conservative wall/d = 12 ms -> cap 4 (the spiral's resting point).
+    eng._burst_walls = {4: 48.0}
+    assert eng._step_ms_estimate() == pytest.approx(12.0)
+    assert eng._burst_depth(busy=False) == 4
+    # A second depth measured: slope (72-48)/(16-4) = 2 ms — C cancels,
+    # the cap recovers (50/2 = 25 -> rung 16) despite C >> step.
+    eng._burst_walls = {4: 48.0, 16: 72.0}
+    assert eng._step_ms_estimate() == pytest.approx(2.0)
+    assert eng._burst_depth(busy=False) == 16
+    # Noise guard: a non-positive slope falls back to the conservative
+    # amortized bound, never a negative/zero step time.
+    eng._burst_walls = {4: 48.0, 16: 40.0}
+    assert eng._step_ms_estimate() == pytest.approx(40.0 / 16)
+
+
+def test_step_time_fit_ignores_stale_depths():
+    """A depth that stopped running holds a wall measured under old
+    conditions; once its sample ages past the window, the fit must not
+    use it (stale w[32] from short-context warmup would UNDERestimate
+    the step time after contexts grow — deepening bursts past the ttft
+    budget)."""
+    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+                            max_seq_len=64, prefill_chunk=16,
+                            dtype="float32", decode_burst=32,
+                            decode_burst_busy=4, ttft_target_ms=100.0)
+    eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
+    eng._burst_walls = {32: 80.0, 16: 72.0}
+    eng._burst_wall_stamp = {32: 1, 16: 1000}
+    eng._burst_wall_n = 1000
+    # Both fresh within the window -> two-point fit would give
+    # (80-72)/16 = 0.5; with 32 stale (age 999 > 512) only depth 16
+    # participates -> conservative 72/16 = 4.5.
+    assert eng._step_ms_estimate() == pytest.approx(72.0 / 16)
+    # All stale -> the newest entry still provides an estimate.
+    eng._burst_wall_n = 2000
+    assert eng._step_ms_estimate() == pytest.approx(72.0 / 16)
+
+
+def test_burst_walls_sample_any_steady_depth():
+    """Every steady same-depth burst pair feeds the per-depth wall model
+    (busy stretches at the shallow depth included — the model must not
+    go stale under sustained load), and a depth transition never
+    samples (its wall mixes two depths)."""
+    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+                            max_seq_len=96, prefill_chunk=16,
+                            dtype="float32", decode_burst=8,
+                            decode_burst_busy=2, ttft_target_ms=100.0)
+    eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
+    eng.lengths[:] = 4
+    eng.active[:] = True
+    eng.last_token[:] = 1
+    eng._d_dirty = True
+    # First burst at 4: transition (no prior same-depth burst) -> no
+    # sample; second at 4: steady pair -> samples depth 4.
+    eng._decode_burst(4)
+    assert eng._burst_walls == {}
+    eng._decode_burst(4)
+    assert set(eng._burst_walls) == {4}
+    # Depth change: the first 8-burst is a transition, the second lands
+    # the 8-sample — now two depths, the fit is live.
+    eng._decode_burst(8)
+    assert set(eng._burst_walls) == {4}
+    eng._decode_burst(8)
+    assert set(eng._burst_walls) == {4, 8}
+    assert eng._step_ms_estimate() is not None
+    assert eng._ema_step_ms_stats is not None
 
 
 def test_no_ttft_target_keeps_fixed_depths():
@@ -327,6 +410,6 @@ def test_no_ttft_target_keeps_fixed_depths():
                             decode_burst_busy=2)
     eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
     assert set(eng._burst_depths) == {2, 8}
-    eng._ema_step_ms = 50.0              # gauge present but target unset
+    eng._burst_walls = {8: 400.0}        # samples present, target unset
     assert eng._burst_depth(busy=False) == 8
     assert eng._burst_depth(busy=True) == 2

@@ -99,9 +99,14 @@ def test_hf_logit_parity_with_sliding_window(tmp_path):
 
 async def _serve(mesh, devs, max_tokens=16, **kw):
     kw.setdefault("attention", "reference")
+    # busy depth == idle depth: parity across engines must not depend
+    # on the prefill/first-decode-round busy race (different scan
+    # depths = different programs = near-tie argmax flips on random
+    # weights; see test_speculative._engine).
+    kw.setdefault("decode_burst_busy", 4)
     cfg = LocalEngineConfig(preset="tiny-mistral-test", max_batch_size=2,
                             max_seq_len=128, prefill_chunk=32,
-                            dtype="float32", decode_burst=4,
+                            dtype="float32", decode_burst=4, mesh=mesh,
                             prewarm_sampler_variants=False,
                             compilation_cache_dir="off", **kw)
     eng = InferenceEngine(cfg, devices=devs)
@@ -201,4 +206,20 @@ async def test_engine_swa_sharded_pallas_matches_reference():
                            attention="pallas")
     assert tp.generated == ref.generated
     assert eng.model_cfg.sliding_window == 16 and eng.mesh.size == 2
+    assert eng.mesh.shape.get("model") == 2     # the REQUESTED mesh ran
+    assert eng._resolve_attention_impl() == "pallas"
+
+
+async def test_engine_swa_paged_sharded_pallas_matches_reference():
+    """SWA x paged on a MULTI-CHIP mesh with the WINDOWED paged kernels:
+    window x page-table indirection x model-axis shard_map is the one
+    composition the dense sharded test can't cover — greedy tokens must
+    match the windowed dense reference engine."""
+    ref, _ = await _serve({}, [cpu_devices()[0]])
+    tp, eng = await _serve({"model": 2}, cpu_devices()[:2],
+                           attention="pallas", kv_layout="paged",
+                           kv_page_size=16)
+    assert tp.generated == ref.generated
+    assert eng.paged and eng.model_cfg.sliding_window == 16
+    assert eng.mesh.shape.get("model") == 2
     assert eng._resolve_attention_impl() == "pallas"

@@ -177,6 +177,11 @@ async def test_engine_pipe_with_paged_kv(engine_kw):
         cfg = LocalEngineConfig(
             preset="tiny-test", max_batch_size=2, max_seq_len=128,
             prefill_chunk=32, dtype="float32", decode_burst=4,
+            # busy == idle depth: exact-parity runs must not depend on
+            # the prefill/first-decode busy race changing the burst
+            # segmentation (different scan depths = different programs
+            # = near-tie argmax flips on random weights).
+            decode_burst_busy=4,
             kv_layout="paged", kv_page_size=16, mesh=mesh,
             attention="reference", prewarm_sampler_variants=False,
             compilation_cache_dir="off", **engine_kw)

@@ -15,6 +15,14 @@ from llmapigateway_tpu.engine.speculative import draft_from_history
 
 
 def _engine(spec=0, **kw):
+    # decode_burst_busy == decode_burst: whether the first decode round
+    # sees `busy` (prefill completion races the round under load) must
+    # not change the burst SEGMENTATION — different scan depths are
+    # different compiled programs whose float rounding can flip a
+    # near-tie argmax on random weights, making exact-parity
+    # comparisons timing-flaky (1-core repro: two stable greedy
+    # continuations of the same prompt).
+    kw.setdefault("decode_burst_busy", 8)
     cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
                             max_seq_len=192, prefill_chunk=32,
                             dtype="float32", decode_burst=8,
@@ -66,10 +74,12 @@ async def test_spec_greedy_parity(spec):
 
 async def test_spec_accepts_on_repetitive_text():
     """On a self-repeating greedy loop the acceptance rate must exceed
-    1 token/step — the whole point of speculating."""
+    1 token/step — the whole point of speculating. Wall gate off: CPU
+    spec wall times would (correctly) close it, but ACCEPTANCE is the
+    subject here."""
     rng = np.random.default_rng(1)
     prompt = list(np.tile(rng.integers(2, 500, 4), 10))
-    eng = _engine(spec=3)
+    eng = _engine(spec=3, spec_wall_gate=False)
     try:
         await _gen(eng, prompt, max_tokens=40)
         stats = eng.stats()
@@ -253,12 +263,86 @@ async def test_adaptive_gate_stays_open_on_repetitive_text():
     high, so drafting stays engaged and still beats 1 token/step."""
     rng = np.random.default_rng(13)
     prompt = list(np.tile(rng.integers(2, 500, 4), 10))
-    eng = _engine(spec=3)       # default spec_min_tokens_per_step=1.2
+    # Wall gate off: CPU wall times per token aren't the subject here —
+    # this test pins the ACCEPTANCE mechanism in isolation.
+    eng = _engine(spec=3, spec_wall_gate=False)
     try:
         await _gen(eng, prompt, max_tokens=40)
         stats = eng.stats()
         assert stats["spec_tokens_per_step"] > 1.0, stats
         assert stats["spec_gate_open"] is True
+    finally:
+        await eng.stop()
+
+
+def test_wall_clock_gate_closes_net_loss_speculation():
+    """The wall-clock gate term (spec_wall_gate): measured spec
+    ms/token above the normal path's closes the gate EVEN when
+    acceptance is high — the v5e spec_mixed regime, where a repetition
+    loop accepts 2.24 tokens/step while each spec step costs ~10x a
+    fused decode step (346.9 vs 1475.1 tok/s with the acceptance-only
+    gate). Gauges are set directly; the decision must follow them."""
+    eng = _engine(spec=3)
+    eng.active[:] = True
+    # Normal path: 4 ms/step across 2 active slots -> 2 ms/token. The
+    # baseline is the fitted step time (per-burst fixed cost removed),
+    # not the any-depth stats gauge.
+    eng._burst_walls = {8: 32.0}
+    # Spec measured at 5 ms/token -> loses; gate reports closed even
+    # though acceptance (unmeasured -> optimistic) would hold it open.
+    eng._spec_ms_per_tok = 5.0
+    assert eng._spec_wall_loses()
+    assert eng.stats()["spec_gate_open"] is False
+    # Spec measured at 1 ms/token -> wins; gate reopens.
+    eng._spec_ms_per_tok = 1.0
+    assert not eng._spec_wall_loses()
+    assert eng.stats()["spec_gate_open"] is True
+    # Knob off restores acceptance-only behavior.
+    eng2 = _engine(spec=3, spec_wall_gate=False)
+    eng2.active[:] = True
+    eng2._burst_walls = {8: 32.0}
+    eng2._spec_ms_per_tok = 50.0
+    assert not eng2._spec_wall_loses()
+    assert eng2.stats()["spec_gate_open"] is True
+
+
+def test_wall_gate_works_with_acceptance_threshold_disabled():
+    """spec_min_tokens_per_step=0 disables only the ACCEPTANCE term:
+    the wall-clock term still gates (and still reports in stats) —
+    otherwise an operator disabling the threshold silently loses the
+    net-loss protection the wall gate exists for."""
+    eng = _engine(spec=3, spec_min_tokens_per_step=0.0)
+    eng.active[:] = True
+    eng._burst_walls = {8: 32.0}       # 4 ms/step -> 2 ms/token
+    eng._spec_ms_per_tok = 5.0         # spec loses
+    assert eng._spec_wall_loses()
+    assert eng.stats()["spec_gate_open"] is False
+    eng._spec_ms_per_tok = 1.0         # spec wins
+    assert eng.stats()["spec_gate_open"] is True
+
+
+async def test_baseline_probe_gives_up_when_no_wall_sample_possible():
+    """Starvation guard: a workload whose normal bursts can never land
+    a wall sample (max_tokens below every compiled rung -> synchronous
+    path) must not pin speculation off forever — after a few fruitless
+    baseline attempts the wall gate stays inert and drafting resumes."""
+    rng = np.random.default_rng(3)
+    prompt = list(np.tile(rng.integers(2, 500, 4), 10))
+    eng = _engine(spec=3)      # compiled rung {8} (busy pinned to 8)
+    try:
+        # Many tiny requests: after the prefill token only 2 decode
+        # steps remain, so every normal burst is capped below the only
+        # compiled rung (8) -> synchronous path -> no steady fused pair
+        # ever lands a wall sample.
+        # Each request is ~1-2 decode rounds, and the guard trips after
+        # 4 fruitless attempts of 2 forced-normal rounds each.
+        for _ in range(14):
+            await _gen(eng, prompt, max_tokens=3)
+        # The guard must have stopped forcing baselines, and drafting
+        # must have actually run.
+        assert eng._spec_base_fails <= 4
+        assert eng._spec_steps_done > 0, \
+            "speculation starved by the baseline probe"
     finally:
         await eng.stop()
 
@@ -279,9 +363,12 @@ async def test_spec_composes_with_seq_and_pipe_sharding(mesh, n_dev):
     prompt = list(np.tile(rng.integers(2, 500, 4), 10))   # cycles early
 
     async def run(m, devs, spec):
+        # busy depth == idle depth: see _engine — parity across engines
+        # must not depend on the prefill/first-decode-round busy race.
         cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
                                 max_seq_len=256, prefill_chunk=32,
                                 dtype="float32", decode_burst=8,
+                                decode_burst_busy=8,
                                 spec_draft_len=spec, mesh=m,
                                 attention="reference",
                                 prewarm_sampler_variants=False,
