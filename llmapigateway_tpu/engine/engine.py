@@ -176,11 +176,17 @@ class InferenceEngine:
                                             self.decode_burst))
         self.ttft_target_ms = max(0.0, engine_cfg.ttft_target_ms)
         # Depths the fused decode scans are compiled for (lazily, on first
-        # use). With a TTFT target the half-deep rung gives the adaptive
-        # cap a real landing spot between deep and busy.
+        # use). With a TTFT target the 3/4, 1/2 and 1/4 rungs give the
+        # adaptive cap real landing spots between deep and busy — the
+        # cap snaps DOWN to a compiled depth, so a coarse ladder forfeits
+        # throughput (e.g. a 26-step budget truncated to 16 when 24
+        # exists ≈ +8% exposure headroom converted to tok/s); each rung
+        # costs one lazily-compiled scan program.
         self._burst_depths = {self.decode_burst, self.decode_burst_busy}
         if self.ttft_target_ms > 0:
-            self._burst_depths.add(max(1, self.decode_burst // 2))
+            for frac in (2, 4):
+                self._burst_depths.add(max(1, self.decode_burst // frac))
+            self._burst_depths.add(max(1, 3 * self.decode_burst // 4))
         self._burst_depths = tuple(sorted(self._burst_depths))
         if engine_cfg.kv_layout not in ("contiguous", "paged"):
             raise ValueError(f"unknown kv_layout {engine_cfg.kv_layout!r}")
@@ -1157,9 +1163,15 @@ class InferenceEngine:
                     if est is not None:
                         self._spec_base_fails = 0
                     self._spec_base_ctr += 1
+                    # Periodic refresh only while a baseline EXISTS —
+                    # once the starvation guard trips (workload can't
+                    # land wall samples), probing again by schedule
+                    # would pay the same fruitless normal rounds
+                    # forever.
                     if ((est is None and self._spec_base_fails < 4)
-                            or self._spec_base_ctr
-                            >= 8 * self.spec_probe_interval):
+                            or (est is not None
+                                and self._spec_base_ctr
+                                >= 8 * self.spec_probe_interval)):
                         self._spec_base_ctr = 0
                         if est is None:
                             self._spec_base_fails += 1
@@ -1181,21 +1193,28 @@ class InferenceEngine:
                         mean_tps = float(np.mean(np.where(
                             np.isnan(ema), self.spec_k + 1, ema)))
                         below = mean_tps < self.spec_min_tps
-                if below or self._spec_wall_loses():
+                wall_lose = self._spec_wall_loses()
+                if below or wall_lose:
                     self._spec_probe_ctr += 1
                     if self._spec_probe_ctr >= self.spec_probe_interval:
                         self._spec_probe_ctr = 0
                         spec_probe = True            # 1-step re-measure
                         # A probe re-measures ACCEPTANCE only. If the
-                        # close was wall-clock, drop the wall gauge every
-                        # few probe cycles so one full burst can re-time
-                        # it under current conditions (bounded tax: one
-                        # possibly-slow burst per 4 probe intervals).
-                        self._spec_wall_age += 1
-                        if (self._spec_ms_per_tok is not None
-                                and self._spec_wall_age >= 4):
-                            self._spec_wall_age = 0
-                            self._spec_ms_per_tok = None
+                        # WALL term is what closed the gate, drop the
+                        # wall gauge every few probe cycles so one full
+                        # burst can re-time it under current conditions
+                        # (bounded tax: one possibly-slow burst per 4
+                        # probe intervals). An acceptance-only close
+                        # must NOT drop it — no full spec burst would
+                        # run to re-measure, silently losing the gauge
+                        # (and its stats field) while a stale-free
+                        # baseline still protects the reopen path.
+                        if wall_lose and not below:
+                            self._spec_wall_age += 1
+                            if (self._spec_ms_per_tok is not None
+                                    and self._spec_wall_age >= 4):
+                                self._spec_wall_age = 0
+                                self._spec_ms_per_tok = None
                     else:
                         spec_now = False
             # While a spec burst is in flight (lag-one), the host lengths

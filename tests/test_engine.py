@@ -301,13 +301,16 @@ def test_ttft_target_caps_idle_burst_depth():
                             dtype="float32", decode_burst=32,
                             decode_burst_busy=4, ttft_target_ms=100.0)
     eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
-    # The half-deep rung is compiled alongside deep and busy.
-    assert set(eng._burst_depths) == {4, 16, 32}
+    # The 3/4, 1/2 and 1/4 rungs are compiled alongside deep and busy.
+    assert set(eng._burst_depths) == {4, 8, 16, 24, 32}
     # No samples yet: run configured depth (the first bursts measure it).
     assert eng._burst_depth(busy=False) == 32
     assert eng._burst_depth(busy=True) == 4
-    # 2 ms/step -> 50 ms budget -> cap 25 -> snaps down to the 16 rung.
+    # 2 ms/step -> 50 ms budget -> cap 25 -> snaps down to the 24 rung.
     eng._burst_walls = {32: 64.0}
+    assert eng._burst_depth(busy=False) == 24
+    # 3 ms/step -> cap 16.7 -> the 16 rung.
+    eng._burst_walls = {32: 96.0}
     assert eng._burst_depth(busy=False) == 16
     # Fast steps: full depth fits the budget.
     eng._burst_walls = {32: 32.0}
@@ -340,10 +343,10 @@ def test_step_time_fit_removes_per_burst_fixed_cost():
     assert eng._step_ms_estimate() == pytest.approx(12.0)
     assert eng._burst_depth(busy=False) == 4
     # A second depth measured: slope (72-48)/(16-4) = 2 ms — C cancels,
-    # the cap recovers (50/2 = 25 -> rung 16) despite C >> step.
+    # the cap recovers (50/2 = 25 -> rung 24) despite C >> step.
     eng._burst_walls = {4: 48.0, 16: 72.0}
     assert eng._step_ms_estimate() == pytest.approx(2.0)
-    assert eng._burst_depth(busy=False) == 16
+    assert eng._burst_depth(busy=False) == 24
     # Noise guard: a non-positive slope falls back to the conservative
     # amortized bound, never a negative/zero step time.
     eng._burst_walls = {4: 48.0, 16: 40.0}
