@@ -465,14 +465,22 @@ class InferenceEngine:
                 num_pages=num_pages if n_bands > 1 else None)
             shape = (c.n_layers, num_pages, c.n_kv_heads, page, c.head_dim)
             # Layout owned by PagedKVCache.create (the one copy of the
-            # int8 {q,s} scheme); 5-D value leaves shard via psh, the 4-D
-            # scale planes via the same spec minus the head_dim axis.
+            # int8 {q,s} scheme); value leaves shard via psh, the rank-4
+            # [.., KV, 1, page] scale planes via the same spec with the
+            # page axis moved last (head_dim dropped, None for the unit
+            # dim).
             pool = PagedKVCache.create(c, num_pages, page, self.dtype,
                                        kv_quant=self.kv_quant)
-            ssh = NamedSharding(self.mesh, P(*psh.spec[:-1]))
-            put = lambda a: jax.device_put(a, psh if a.ndim == 5 else ssh)
-            self.cache = PagedKVCache(k=jax.tree.map(put, pool.k),
-                                      v=jax.tree.map(put, pool.v))
+            ssh = NamedSharding(
+                self.mesh, P(*psh.spec[:-2], None, psh.spec[-2]))
+
+            def put_side(side):
+                if isinstance(side, dict):
+                    return {"q": jax.device_put(side["q"], psh),
+                            "s": jax.device_put(side["s"], ssh)}
+                return jax.device_put(side, psh)
+            self.cache = PagedKVCache(k=put_side(pool.k),
+                                      v=put_side(pool.v))
             self._d_table = None
             self._table_dirty = True
         else:
@@ -483,12 +491,16 @@ class InferenceEngine:
                 n_layers=c.n_layers if self.pipe_n > 1 else None)
             shape = (c.n_layers, self.B, c.n_kv_heads, self.S, c.head_dim)
             if self.kv_quant == "int8":
-                # int8 values + per-token fp32 scales (same sharding minus
-                # the head_dim axis).
-                ssh = NamedSharding(self.mesh, P(*csh.spec[:-1]))
+                # int8 values + per-token fp32 scales, stored rank-4
+                # [L, B, KV, 1, S] (models/llama.py KVCache): the value
+                # sharding with the S axis moved last (head_dim dropped,
+                # None for the unit dim) — a seq-sharded S stays sharded.
+                ssh = NamedSharding(
+                    self.mesh, P(*csh.spec[:-2], None, csh.spec[-2]))
                 def qz():
                     return {"q": zeros_global(shape, jnp.int8, csh),
-                            "s": zeros_global(shape[:-1], jnp.float32, ssh)}
+                            "s": zeros_global(shape[:-2] + (1, shape[-2]),
+                                              jnp.float32, ssh)}
                 self.cache = llama.KVCache(k=qz(), v=qz())
             else:
                 self.cache = llama.KVCache(

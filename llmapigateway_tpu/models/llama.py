@@ -158,10 +158,18 @@ class KVCache(NamedTuple):
     so the cache stays a plain pytree of arrays.
 
     With KV quantization (``kv_quant: "int8"``) each of k/v is instead the
-    sub-dict ``{"q": int8 [L,B,KV,S,Dh], "s": f32 [L,B,KV,S]}`` — symmetric
-    per-token-per-head scales, the same plain-or-quantized dict convention
-    as weight quant (models/quant.py). Ordinary pytree leaves: the layer
-    scan, GSPMD shardings, and row slicing all treat them uniformly.
+    sub-dict ``{"q": int8 [L,B,KV,S,Dh], "s": f32 [L,B,KV,1,S]}`` —
+    symmetric per-token-per-head scales, the same plain-or-quantized dict
+    convention as weight quant (models/quant.py). Ordinary pytree leaves:
+    the layer scan, GSPMD shardings, and row slicing all treat them
+    uniformly. The scales carry a unit dim before the token axis: that is
+    the rank the Pallas kernels' BlockSpecs need (trailing block dims
+    ``(1, block)`` are legal under Mosaic's (8, 128) tiling rule for any
+    KV — a ``[.., KV, S]`` layout would need an illegal KV-dim block of
+    1), and storing it natively means NO per-call relayout of the scale
+    tensors (which scales with CACHE CAPACITY, not live context — on a
+    large paged pool the reshape alternative costs whole milliseconds per
+    step). The jnp reference paths broadcast it for free.
     """
     k: Any
     v: Any
@@ -174,7 +182,8 @@ class KVCache(NamedTuple):
         if kv_quant == "int8":
             def qz():
                 return {"q": jnp.zeros(shape, jnp.int8),
-                        "s": jnp.zeros(shape[:-1], jnp.float32)}
+                        "s": jnp.zeros(shape[:-2] + (1, shape[-2]),
+                                       jnp.float32)}
             return cls(k=qz(), v=qz())
         return cls(k=jnp.zeros(shape, dtype=dtype),
                    v=jnp.zeros(shape, dtype=dtype))
@@ -219,9 +228,10 @@ def insert_kv(layer_k, layer_v, k_new: jax.Array,
             (0, offset, 0))
 
     def insert_s(scale_row, new_row, offset):
-        # scale_row [KV, S]; new_row [T, KV] → [KV, T]
+        # scale_row [KV, 1, S]; new_row [T, KV] → [KV, 1, T]
         return jax.lax.dynamic_update_slice(
-            scale_row, new_row.T.astype(scale_row.dtype), (0, offset))
+            scale_row, new_row.T[:, None, :].astype(scale_row.dtype),
+            (0, 0, offset))
 
     if quant:
         kq, ks = quantize_kv(k_new)                  # [B,T,KV,Dh], [B,T,KV]
@@ -264,9 +274,10 @@ def insert_kv_stacked(cache_k, cache_v,
             ck, new.transpose(0, 2, 1, 3).astype(ck.dtype), (0, 0, off, 0))
 
     def ins_s(cs, new, off):
-        # cs [L, KV, S]; new [L, T, KV] → [L, KV, T]
+        # cs [L, KV, 1, S]; new [L, T, KV] → [L, KV, 1, T]
         return jax.lax.dynamic_update_slice(
-            cs, new.transpose(0, 2, 1).astype(cs.dtype), (0, 0, off))
+            cs, new.transpose(0, 2, 1)[:, :, None, :].astype(cs.dtype),
+            (0, 0, 0, off))
 
     if quant:
         kq, ks = quantize_kv(k_news)          # [L,B,T,KV,Dh], [L,B,T,KV]
@@ -316,7 +327,7 @@ def dense_decode_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     scores = jnp.einsum("bkgd,bksd->bkgs", qg, lk,
                         preferred_element_type=jnp.float32) * scale
     if ks is not None:
-        scores = scores * ks[:, :, None, :]
+        scores = scores * ks          # [B,KV,1,S] broadcasts over G
     self_s = jnp.einsum("bkgd,bkd->bkg", qg, kn,
                         preferred_element_type=jnp.float32) * scale
 
@@ -336,7 +347,7 @@ def dense_decode_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     p_self = jnp.exp(self_s - m)                                   # [B,KV,G]
     l = jnp.sum(p, axis=-1) + p_self
     if vs is not None:
-        p = p * vs[:, :, None, :]
+        p = p * vs                    # [B,KV,1,S] broadcasts over G
     out = jnp.einsum("bkgs,bksd->bkgd", p.astype(lv.dtype), lv,
                      preferred_element_type=jnp.float32)
     out = (out + p_self[..., None] * vn[:, :, None, :]) / l[..., None]
@@ -347,7 +358,9 @@ def _kv_dequant_views(layer_k, layer_v, dtype):
     """(k, ks, v, vs) from a plain or int8-quantized cache layer. The
     per-token scale factors OUT of the Dh contraction — scores multiply by
     ``ks`` after the QK dot, probs by ``vs`` before the PV dot — so no
-    dequantized [S, Dh] copy ever materializes."""
+    dequantized [S, Dh] copy ever materializes. Scales come back in their
+    stored rank-4 form ([B, KV, 1, S] — the unit dim broadcasts over G in
+    the [B, KV, G, S] score layout for free)."""
     if isinstance(layer_k, dict):
         return (layer_k["q"].astype(dtype), layer_k["s"],
                 layer_v["q"].astype(dtype), layer_v["s"])
@@ -386,7 +399,7 @@ def dense_verify_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     scores = jnp.einsum("bkgtd,bksd->bkgts", qg, lk,
                         preferred_element_type=jnp.float32) * scale
     if ks is not None:
-        scores = scores * ks[:, :, None, None, :]
+        scores = scores * ks[:, :, :, None, :]    # [B,KV,1,1,S]
     self_s = jnp.einsum("bkgtd,bkud->bkgtu", qg, kn,
                         preferred_element_type=jnp.float32) * scale
 
@@ -419,7 +432,7 @@ def dense_verify_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
     p_self = jnp.exp(self_s - m[..., None])                 # [B,KV,G,T,T]
     l = jnp.sum(p, axis=-1) + jnp.sum(p_self, axis=-1)
     if vs is not None:
-        p = p * vs[:, :, None, None, :]
+        p = p * vs[:, :, :, None, :]              # [B,KV,1,1,S]
     out = jnp.einsum("bkgts,bksd->bkgtd", p.astype(lv.dtype), lv,
                      preferred_element_type=jnp.float32)
     out = out + jnp.einsum("bkgtu,bkud->bkgtd", p_self, vn)
@@ -464,7 +477,7 @@ def dense_cache_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
                         preferred_element_type=jnp.float32)
     scores = scores / jnp.sqrt(jnp.asarray(Dh, jnp.float32))
     if ks is not None:
-        scores = scores * ks[:, :, None, None, :]
+        scores = scores * ks[:, :, :, None, :]    # [B,KV,1,1,S]
 
     # Mask: key position s is visible to query t iff s <= lengths + t
     # (and, with a sliding window, within `window` of it).
@@ -479,7 +492,7 @@ def dense_cache_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
 
     probs = jax.nn.softmax(scores, axis=-1)
     if vs is not None:
-        probs = probs * vs[:, :, None, None, :]
+        probs = probs * vs[:, :, :, None, :]      # [B,KV,1,1,S]
     out = jnp.einsum("bkgts,bksd->bkgtd", probs.astype(lv.dtype),
                      lv, preferred_element_type=jnp.float32)
     # [B,KV,G,T,Dh] → [B,T,H*Dh]

@@ -212,15 +212,17 @@ def flash_decode_attention(q: jax.Array, k_new: jax.Array,
         first, last = _live_range(nv[b])
         return b, h, 0, jnp.clip(s, first, last)
 
-    # Scales ride as rank-4 [B, KV, 1, S] so the block's trailing dims are
-    # (1, block_s) — legal under the TPU (8, 128) tiling rule for any KV
-    # (a (1, block_s) block of the stored [B, KV, S] would block the KV
-    # dim at 1, which real Mosaic lowering rejects; see attend_block).
+    # Scales are STORED rank-4 [B, KV, 1, S] (models/llama.py KVCache) so
+    # the block's trailing dims are (1, block_s) — legal under the TPU
+    # (8, 128) tiling rule for any KV (a (1, block_s) block of a
+    # [B, KV, S] layout would block the KV dim at 1, which real Mosaic
+    # lowering rejects; see attend_block) — and no per-call relayout of
+    # the scale tensor is needed.
     kv_spec = pl.BlockSpec((1, 1, block_s, Dh), kv_index)
     s_spec = pl.BlockSpec((1, 1, 1, block_s), scale_index)
     if quant:
-        kv_operands = (layer_k["q"], layer_k["s"][:, :, None, :],
-                       layer_v["q"], layer_v["s"][:, :, None, :])
+        kv_operands = (layer_k["q"], layer_k["s"],
+                       layer_v["q"], layer_v["s"])
         kv_specs = [kv_spec, s_spec, kv_spec, s_spec]
     else:
         kv_operands = (layer_k, layer_v)
@@ -353,12 +355,12 @@ def flash_prefill_attention(q: jax.Array, layer_k, layer_v,
         first, last = _live_range(st[b], t)
         return b, h // G, 0, jnp.clip(s, first, last)
 
-    # Rank-4 [B, KV, 1, S] scale layout — see flash_decode_attention.
+    # Stored rank-4 [B, KV, 1, S] scale layout — see flash_decode_attention.
     kv_spec = pl.BlockSpec((1, 1, block_s, Dh), kv_index)
     s_spec = pl.BlockSpec((1, 1, 1, block_s), scale_index)
     if quant:
-        kv_operands = (layer_k["q"], layer_k["s"][:, :, None, :],
-                       layer_v["q"], layer_v["s"][:, :, None, :])
+        kv_operands = (layer_k["q"], layer_k["s"],
+                       layer_v["q"], layer_v["s"])
         kv_specs = [kv_spec, s_spec, kv_spec, s_spec]
     else:
         kv_operands = (layer_k, layer_v)
@@ -482,12 +484,13 @@ def make_sharded_cache_attention_fn(mesh, block_s: int | None = None,
         return model, data, {ax for ax in (model, data) if ax}
 
     def _cache_spec(side, data, model):
-        """Per-leaf spec: an int8 {"q","s"} cache leaf carries a 4-D value
-        + 3-D scale plane (scale spec = value spec minus head_dim) — a
-        prefix spec would rank-mismatch the scale leaf."""
+        """Per-leaf spec: an int8 {"q","s"} cache leaf carries a 4-D
+        [B, KV, S, Dh] value + 4-D [B, KV, 1, S] scale plane (batch and
+        head dims shard identically; the scale's trailing (1, S) dims
+        stay whole)."""
         val = P(data, model, None, None)
         if isinstance(side, dict):
-            return {"q": val, "s": P(data, model, None)}
+            return {"q": val, "s": P(data, model, None, None)}
         return val
 
     def attention_fn(q, k_new, v_new, layer_k, layer_v, lengths, active=None):

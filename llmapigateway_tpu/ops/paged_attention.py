@@ -54,8 +54,9 @@ class PagedKVCache(NamedTuple):
     """k, v: [L, P, KV, page, Dh] — global page pool per layer. Scans over
     the leading layer dim in llama.forward exactly like the dense KVCache.
     With ``kv_quant="int8"`` each of k/v is the ``{"q": int8, "s": f32
-    [L, P, KV, page]}`` dict (per-token-per-head scales — models/llama.py
-    KVCache convention)."""
+    [L, P, KV, 1, page]}`` dict (per-token-per-head scales; the unit dim
+    before the token axis is the Mosaic-legal, relayout-free rank the
+    kernels consume — models/llama.py KVCache convention)."""
     k: Any
     v: Any
 
@@ -67,7 +68,8 @@ class PagedKVCache(NamedTuple):
         if kv_quant == "int8":
             def qz():
                 return {"q": jnp.zeros(shape, jnp.int8),
-                        "s": jnp.zeros(shape[:-1], jnp.float32)}
+                        "s": jnp.zeros(shape[:-2] + (1, shape[-2]),
+                                       jnp.float32)}
             return cls(k=qz(), v=qz())
         return cls(k=jnp.zeros(shape, dtype=dtype),
                    v=jnp.zeros(shape, dtype=dtype))
@@ -114,15 +116,21 @@ def paged_insert_kv(layer_k, layer_v,
         return pool.at[flat_page, :, flat_off].set(
             new.astype(pool.dtype), mode="promise_in_bounds")
 
+    def scatter_s(pool, new):
+        # Scale pool [P, KV, 1, page]: same token positions, through the
+        # unit dim.
+        return pool.at[flat_page, :, 0, flat_off].set(
+            new.astype(pool.dtype), mode="promise_in_bounds")
+
     if quant:
         from ..models.llama import quantize_kv
         kq, ks = quantize_kv(k_new)                  # [B,T,KV,Dh], [B,T,KV]
         vq, vs = quantize_kv(v_new)
         return (
             {"q": scatter(layer_k["q"], kq.reshape(B * T, KV, Dh)),
-             "s": scatter(layer_k["s"], ks.reshape(B * T, KV))},
+             "s": scatter_s(layer_k["s"], ks.reshape(B * T, KV))},
             {"q": scatter(layer_v["q"], vq.reshape(B * T, KV, Dh)),
-             "s": scatter(layer_v["s"], vs.reshape(B * T, KV))},
+             "s": scatter_s(layer_v["s"], vs.reshape(B * T, KV))},
         )
     layer_k = scatter(layer_k, k_new.reshape(B * T, KV, Dh))
     layer_v = scatter(layer_v, v_new.reshape(B * T, KV, Dh))
@@ -162,13 +170,21 @@ def paged_insert_all(pool_k, pool_v,
         new = news[:, :, 0].swapaxes(0, 1).astype(pool.dtype)
         return pool.at[:, phys, :, off].set(new, mode="promise_in_bounds")
 
+    def scatter_s(pool, news):
+        # Scale pool [L, P, KV, 1, page]: through the unit dim.
+        new = news[:, :, 0].swapaxes(0, 1).astype(pool.dtype)
+        return pool.at[:, phys, :, 0, off].set(new,
+                                               mode="promise_in_bounds")
+
     if quant:
         from ..models.llama import quantize_kv
         kq, ks = quantize_kv(k_news)      # [L,B,1,KV,Dh], [L,B,1,KV]
         vq, vs = quantize_kv(v_news)
         return (
-            {"q": scatter(pool_k["q"], kq), "s": scatter(pool_k["s"], ks)},
-            {"q": scatter(pool_v["q"], vq), "s": scatter(pool_v["s"], vs)},
+            {"q": scatter(pool_k["q"], kq),
+             "s": scatter_s(pool_k["s"], ks)},
+            {"q": scatter(pool_v["q"], vq),
+             "s": scatter_s(pool_v["s"], vs)},
         )
     return (scatter(pool_k, k_news), scatter(pool_v, v_news))
 
@@ -265,14 +281,15 @@ def paged_decode_attention(q: jax.Array, k_new: jax.Array,
         first, last = _live_range(nv[b])
         return pt[b, jnp.clip(j, first, last)], h, 0, 0
 
-    # Scales ride as rank-4 [P, KV, 1, page] so the block's trailing dims
-    # are (1, page) — legal under the TPU (8, 128) tiling rule for any KV
-    # (see flash_attention.attend_block).
+    # Scales are STORED rank-4 [P, KV, 1, page] so the block's trailing
+    # dims are (1, page) — legal under the TPU (8, 128) tiling rule for
+    # any KV (see flash_attention.attend_block) — with no per-call
+    # relayout of the pool-sized scale tensor.
     kv_spec = pl.BlockSpec((1, 1, page, Dh), kv_index)
     s_spec = pl.BlockSpec((1, 1, 1, page), scale_index)
     if quant:
-        kv_operands = (k_pages["q"], k_pages["s"][:, :, None, :],
-                       v_pages["q"], v_pages["s"][:, :, None, :])
+        kv_operands = (k_pages["q"], k_pages["s"],
+                       v_pages["q"], v_pages["s"])
         kv_specs = [kv_spec, s_spec, kv_spec, s_spec]
     else:
         kv_operands = (k_pages, v_pages)
@@ -402,12 +419,13 @@ def paged_prefill_attention(q: jax.Array, k_pages, v_pages,
         first, last = _live_range(st[b], t)
         return pt[b, jnp.clip(j, first, last)], h // G, 0, 0
 
-    # Rank-4 [P, KV, 1, page] scale layout — see paged_decode_attention.
+    # Stored rank-4 [P, KV, 1, page] scale layout — see
+    # paged_decode_attention.
     kv_spec = pl.BlockSpec((1, 1, page, Dh), kv_index)
     s_spec = pl.BlockSpec((1, 1, 1, page), scale_index)
     if quant:
-        kv_operands = (k_pages["q"], k_pages["s"][:, :, None, :],
-                       v_pages["q"], v_pages["s"][:, :, None, :])
+        kv_operands = (k_pages["q"], k_pages["s"],
+                       v_pages["q"], v_pages["s"])
         kv_specs = [kv_spec, s_spec, kv_spec, s_spec]
     else:
         kv_operands = (k_pages, v_pages)
@@ -445,11 +463,13 @@ def paged_prefill_attention(q: jax.Array, k_pages, v_pages,
 
 def gather_pages(layer_pages, page_table: jax.Array, max_seq: int):
     """Materialize the dense [B, KV, S(, Dh)] view from the pool —
-    reference path only. Dict pools gather per leaf (the int8 values and
-    their scale plane share the page geometry)."""
+    reference path only. Dict pools gather per leaf; the rank-4
+    [P, KV, 1, page] scale plane gathers through its squeezed rank-3
+    view and comes back rank-4 [B, KV, 1, S] (the dense stored form)."""
     if isinstance(layer_pages, dict):
-        return {k: gather_pages(v, page_table, max_seq)
-                for k, v in layer_pages.items()}
+        s = gather_pages(layer_pages["s"][:, :, 0, :], page_table, max_seq)
+        return {"q": gather_pages(layer_pages["q"], page_table, max_seq),
+                "s": s[:, :, None, :]}
     KV, page = layer_pages.shape[1], layer_pages.shape[2]
     NP = page_table.shape[1]
     n_pages = min(NP, (max_seq + page - 1) // page)
@@ -458,6 +478,18 @@ def gather_pages(layer_pages, page_table: jax.Array, max_seq: int):
     seq = picked.reshape(page_table.shape[0], KV, n_pages * page,
                          *picked.shape[4:])
     return seq[:, :, :max_seq]
+
+
+def dequant_gathered(d, dtype):
+    """Gathered pool dict → dense float view (reference paths only; the
+    Pallas kernels consume the int8 pool + scales directly). The gathered
+    scale is rank-4 [B, KV, 1, S] (gather_pages owns that form); swapping
+    its trailing dims broadcasts it against the [B, KV, S, Dh] values.
+    THE one copy of the int8-KV dequant — the per-mesh adapters share it."""
+    if isinstance(d, dict):
+        return d["q"].astype(dtype) * jnp.swapaxes(
+            d["s"], -1, -2).astype(dtype)
+    return d
 
 
 def _paged_reference_core(q, dense_k, dense_v, lengths, active, T,
@@ -509,20 +541,15 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
 
     msize = mesh.shape.get("model", 1) if mesh is not None else 1
 
-    def _dequant_dense(d, dtype):
-        """Gathered dict → dense float view (reference path only; the
-        Pallas kernels consume the int8 pool + scales directly)."""
-        if isinstance(d, dict):
-            return d["q"].astype(dtype) * d["s"][..., None].astype(dtype)
-        return d
+    _dequant_dense = dequant_gathered
 
     def _pool_spec(side):
         """Per-leaf shard_map spec for a per-layer pool side: the int8
-        scale plane is 3-D ([P, KV, page] — the 4-D value minus head_dim),
-        so a prefix spec would rank-mismatch it."""
+        scale plane is rank-4 [P, KV, 1, page] (head dim shards like the
+        value's; the trailing (1, page) dims stay whole)."""
         val = P(None, "model", None, None)
         if isinstance(side, dict):
-            return {"q": val, "s": P(None, "model", None)}
+            return {"q": val, "s": P(None, "model", None, None)}
         return val
 
     def attention_fn(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
@@ -624,21 +651,22 @@ def _seq_local_table(page_table: jax.Array, seq_n: int,
 
 
 def _leaf_specs(side):
-    """Per-leaf shard_map specs for a pool side (dict-aware: the int8
-    scale plane has one fewer dim): the page dim — 0 for a per-layer
-    side, 1 for a stacked [L, ...] one — rides the ``seq`` axis."""
+    """Per-leaf shard_map specs for a pool side (dict-aware: the rank-4
+    [P, KV, 1, page] scale plane has the SAME rank and page-dim position
+    as its value): the page dim — 0 for a per-layer side, 1 for a
+    stacked [L, ...] one — rides the ``seq`` axis."""
     from jax.sharding import PartitionSpec as P
     if isinstance(side, dict):
         nd = side["q"].ndim
     else:
         nd = side.ndim
-    ax = 0 if nd in (4, 3) else 1                 # per-layer vs stacked [L,…]
+    ax = 0 if nd == 4 else 1                      # per-layer vs stacked [L,…]
     def spec(ndim):
         parts = [None] * ndim
         parts[ax] = "seq"
         return P(*parts)
     if isinstance(side, dict):
-        return {"q": spec(nd), "s": spec(nd - 1)}
+        return {"q": spec(nd), "s": spec(nd)}
     return spec(nd)
 
 
@@ -677,7 +705,12 @@ def make_seq_paged_attention_fn(page_table: jax.Array, max_seq: int, mesh):
             B = cols.shape[0]
             KV, page = leaf.shape[1], leaf.shape[2]
             return picked.reshape(B, KV, spb * page, *leaf.shape[3:])
-        return jax.tree.map(g, pool)
+        if isinstance(pool, dict):
+            # Scale leaf [Pl, KV, 1, page]: gather through its squeezed
+            # rank-3 view, return the dense stored form [B, KV, 1, S].
+            return {"q": g(pool["q"]),
+                    "s": g(pool["s"][:, :, 0, :])[:, :, None, :]}
+        return g(pool)
 
     def _band_pages(pool):
         leaf = pool["q"] if isinstance(pool, dict) else pool
@@ -688,7 +721,7 @@ def make_seq_paged_attention_fn(page_table: jax.Array, max_seq: int, mesh):
         def out_spec(side):
             if isinstance(side, dict):
                 return {"q": P(None, None, "seq", None),
-                        "s": P(None, None, "seq")}
+                        "s": P(None, None, None, "seq")}
             return P(None, None, "seq", None)
         return jax.shard_map(
             _gather_local, mesh=mesh,
@@ -722,12 +755,9 @@ def make_seq_paged_attention_fn(page_table: jax.Array, max_seq: int, mesh):
         dk = gather_view(layer_k)
         dv = gather_view(layer_v)
 
-        def deq(d):
-            if isinstance(d, dict):
-                return d["q"].astype(q.dtype) * d["s"][..., None].astype(
-                    q.dtype)
-            return d
-        out = _paged_reference_core(q, deq(dk), deq(dv), lengths, active, T)
+        out = _paged_reference_core(q, dequant_gathered(dk, q.dtype),
+                                    dequant_gathered(dv, q.dtype),
+                                    lengths, active, T)
         return out, layer_k, layer_v
 
     def decode(q, k_new, v_new, layer_k, layer_v, lengths, active=None):

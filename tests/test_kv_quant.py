@@ -79,7 +79,7 @@ def test_pallas_q8_kernels_match_jnp_reference(setup):
     k_hist = jnp.asarray(rng.standard_normal((B, 48, KV, Dh)), jnp.float32)
     v_hist = jnp.asarray(rng.standard_normal((B, 48, KV, Dh)), jnp.float32)
     zero = {"q": jnp.zeros((B, KV, S, Dh), jnp.int8),
-            "s": jnp.zeros((B, KV, S), jnp.float32)}
+            "s": jnp.zeros((B, KV, 1, S), jnp.float32)}
     lk, lv = llama.insert_kv(dict(zero), dict(zero), k_hist, v_hist,
                              jnp.zeros((B,), jnp.int32), None)
 
@@ -202,7 +202,8 @@ def test_paged_q8_kernels_match_reference(setup):
     from llmapigateway_tpu.ops.paged_attention import _paged_reference_core
 
     def deq(d):
-        return d["q"].astype(jnp.float32) * d["s"][..., None]
+        # Gathered scale is rank-4 [B, KV, 1, S] -> [B, KV, S, 1].
+        return d["q"].astype(jnp.float32) * jnp.swapaxes(d["s"], -1, -2)
     want2 = np.asarray(_paged_reference_core(
         qT, deq(gather_pages(lk2, table, S)),
         deq(gather_pages(lv2, table, S)), start, None, T), np.float32)

@@ -136,8 +136,9 @@ def test_decode_kernel_sliding_window_int8_cache():
     q, k_new, v_new, layer_k, layer_v = _mk(B, S, 1, H, KV, Dh, seed=5)
     kq, ks = quantize_kv(layer_k)
     vq, vs = quantize_kv(layer_v)
-    qk = {"q": kq, "s": ks}
-    qv = {"q": vq, "s": vs}
+    # Stored scale layout is rank-4 [B, KV, 1, S] (llama.KVCache).
+    qk = {"q": kq, "s": ks[:, :, None, :]}
+    qv = {"q": vq, "s": vs[:, :, None, :]}
     lengths = jnp.asarray([0, 23, 61], jnp.int32)
     ref = dense_decode_attention(q, k_new, v_new, qk, qv, lengths, window=W)
     got = flash_decode_attention(

@@ -1,0 +1,105 @@
+"""AOT TPU-lowering checks for every Pallas kernel variant.
+
+Mosaic enforces TPU layout rules (e.g. a block's trailing two dims must
+be (8, 128)-divisible or equal the array dims) at LOWERING time — which
+``interpret=True`` CPU tests never reach. The first on-chip bench ladder
+(2026-07-31) found exactly such a bug: the int8-KV per-token scale
+tensors' ``(1, 1, block)`` BlockSpecs put a size-1 block on the KV dim,
+killing the 8B/kv-quant/int4/SWA rungs on hardware while 264 CPU tests
+stayed green (fixed by the rank-4 ``[B, KV, 1, S]`` scale layout,
+flash_attention.py). ``jax.jit(f).trace(...).lower(lowering_platforms=
+("tpu",))`` runs that validation on a CPU-only box, so this module keeps
+the whole dense/paged x decode/prefill x bf16/int8-KV x windowed matrix
+lowerable without ever touching a chip.
+
+These tests do NOT execute anything — success is "Mosaic accepted the
+kernel"; numerics are covered by the interpret-mode parity suites
+(test_ops_attention / test_ops_paged / test_kv_quant).
+"""
+import jax
+import jax.numpy as jnp
+import pytest
+
+from llmapigateway_tpu.ops import paged_attention as pa
+from llmapigateway_tpu.ops.flash_attention import (
+    flash_decode_attention, flash_prefill_attention)
+
+B, KV, G, S, Dh, T = 2, 4, 2, 256, 128, 128
+H = KV * G
+P, PAGE, NP = 16, 128, 2
+
+
+def _dense_kv(quant):
+    key = jax.random.PRNGKey(0)
+    if quant:
+        mk = lambda: {"q": jax.random.randint(key, (B, KV, S, Dh),
+                                              -127, 127, jnp.int8),
+                      "s": jnp.ones((B, KV, 1, S), jnp.float32)}
+    else:
+        mk = lambda: jax.random.normal(key, (B, KV, S, Dh), jnp.bfloat16)
+    return mk(), mk()
+
+
+def _paged_kv(quant):
+    key = jax.random.PRNGKey(0)
+    if quant:
+        mk = lambda: {"q": jax.random.randint(key, (P, KV, PAGE, Dh),
+                                              -127, 127, jnp.int8),
+                      "s": jnp.ones((P, KV, 1, PAGE), jnp.float32)}
+    else:
+        mk = lambda: jax.random.normal(key, (P, KV, PAGE, Dh), jnp.bfloat16)
+    return mk(), mk()
+
+
+def _lower(fn, *args):
+    jax.jit(fn).trace(*args).lower(lowering_platforms=("tpu",))
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8kv"])
+@pytest.mark.parametrize("window", [0, 96], ids=["full", "windowed"])
+def test_dense_decode_lowers_for_tpu(quant, window):
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (B, H, Dh), jnp.bfloat16)
+    kn = jax.random.normal(key, (B, KV, Dh), jnp.bfloat16)
+    vn = jax.random.normal(key, (B, KV, Dh), jnp.bfloat16)
+    lk, lv = _dense_kv(quant)
+    ns = jnp.array([100, 0], jnp.int32)
+    _lower(lambda *a: flash_decode_attention(
+        *a, window=window, interpret=False), q, kn, vn, lk, lv, ns)
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8kv"])
+@pytest.mark.parametrize("window", [0, 96], ids=["full", "windowed"])
+def test_dense_prefill_lowers_for_tpu(quant, window):
+    key = jax.random.PRNGKey(0)
+    qp = jax.random.normal(key, (B, T, H, Dh), jnp.bfloat16)
+    lk, lv = _dense_kv(quant)
+    st = jnp.array([0, 64], jnp.int32)
+    _lower(lambda *a: flash_prefill_attention(
+        *a, window=window, interpret=False), qp, lk, lv, st)
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8kv"])
+@pytest.mark.parametrize("window", [0, 96], ids=["full", "windowed"])
+def test_paged_decode_lowers_for_tpu(quant, window):
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (B, H, Dh), jnp.bfloat16)
+    kn = jax.random.normal(key, (B, KV, Dh), jnp.bfloat16)
+    vn = jax.random.normal(key, (B, KV, Dh), jnp.bfloat16)
+    pk, pv = _paged_kv(quant)
+    ptab = jnp.array([[1, 2], [3, 4]], jnp.int32)
+    ns = jnp.array([100, 0], jnp.int32)
+    _lower(lambda *a: pa.paged_decode_attention(
+        *a, window=window, interpret=False), q, kn, vn, pk, pv, ptab, ns)
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8kv"])
+@pytest.mark.parametrize("window", [0, 96], ids=["full", "windowed"])
+def test_paged_prefill_lowers_for_tpu(quant, window):
+    key = jax.random.PRNGKey(0)
+    qp = jax.random.normal(key, (B, T, H, Dh), jnp.bfloat16)
+    pk, pv = _paged_kv(quant)
+    ptab = jnp.array([[1, 2], [3, 4]], jnp.int32)
+    st = jnp.array([0, 64], jnp.int32)
+    _lower(lambda *a: pa.paged_prefill_attention(
+        *a, window=window, interpret=False), qp, pk, pv, ptab, st)
