@@ -95,6 +95,39 @@ def test_paged_decode_lowers_for_tpu(quant, window):
 
 @pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8kv"])
 @pytest.mark.parametrize("window", [0, 96], ids=["full", "windowed"])
+def test_tp_sharded_decode_wrapper_lowers_for_tpu(quant, window):
+    """The shard_map'd flash decode wrapper (what a TP-sharded engine
+    actually runs) must lower for TPU too — shard_map + Mosaic compose
+    at lowering time, so this works on the CPU-device mesh. Windowed
+    variants cover the sharded-SWA configs (commit 20722ad)."""
+    from jax.sharding import Mesh
+
+    from llmapigateway_tpu.ops.flash_attention import (
+        make_sharded_cache_attention_fn)
+
+    mesh = Mesh(jax.devices("cpu")[:4], ("model",))
+    # Guard against the wrapper's silent unsharded fallback: KV and H
+    # must divide the model axis, or the test lowers the WRONG path.
+    assert KV % 4 == 0 and H % 4 == 0
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (B, 1, H, Dh), jnp.bfloat16)
+    kn = jax.random.normal(key, (B, 1, KV, Dh), jnp.bfloat16)
+    vn = jax.random.normal(key, (B, 1, KV, Dh), jnp.bfloat16)
+    lk, lv = _dense_kv(quant)
+    ns = jnp.array([100, 0], jnp.int32)
+    fn = make_sharded_cache_attention_fn(mesh, interpret=False,
+                                         window=window)
+    lowered = jax.jit(lambda *a: fn.decode(*a)).trace(
+        q, kn, vn, lk, lv, ns).lower(lowering_platforms=("tpu",))
+    # The shard_map path really ran: a Mosaic kernel is in the module
+    # (the unsharded fallback would also contain one, but the fallback
+    # is excluded by the divisibility assert above — this check instead
+    # pins that lowering went all the way to a TPU custom call).
+    assert "tpu_custom_call" in lowered.as_text()
+
+
+@pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8kv"])
+@pytest.mark.parametrize("window", [0, 96], ids=["full", "windowed"])
 def test_paged_prefill_lowers_for_tpu(quant, window):
     key = jax.random.PRNGKey(0)
     qp = jax.random.normal(key, (B, T, H, Dh), jnp.bfloat16)
