@@ -763,6 +763,68 @@ def main() -> None:
                     break               # non-OOM errors won't heal at bs/2
             finally:
                 engine = None
+        # bs-2x scale leg: decode at 8B is weight-bandwidth-bound, so
+        # tok/s = B / step_ms and the weight stream per step is a FIXED
+        # ~8 GB — doubling the batch nearly doubles tok/s for +~1 GB of
+        # int8 KV (measured r5b: bs=32 ran 23.0 ms/step at 392 GB/s,
+        # only 0.478 of HBM peak; more rows per step is the cheapest
+        # path to the 2k target while the bandwidth gap is worked).
+        if "headline_8b" in extra and not over_budget("headline_8b_bs2x"):
+            b2 = 2 * extra["headline_8b"]["batch"]
+            try:
+                engine = None
+                bargs = eight_b_args(b2)
+                engine, _ = build_engine(
+                    bargs, "contiguous", preset=args.eight_b_preset,
+                    batch=b2, quant="int8", kv_quant="int8")
+                r = fill_and_time_decode(engine, bargs,
+                                         steps=args.eight_b_steps)
+                extra["headline_8b"]["bs2x_batch"] = b2
+                extra["headline_8b"]["bs2x_tok_s"] = r["tok_s"]
+                extra["headline_8b"]["bs2x_ms_per_step"] = \
+                    r["ms_per_decode_step"]
+                extra["headline_8b"]["bs2x_vs_target_2k"] = round(
+                    r["tok_s"] / 2000.0, 3)
+                note(f"8B north star bs={b2}: {r['tok_s']} tok/s "
+                     f"({extra['headline_8b']['bs2x_vs_target_2k']}x the "
+                     f"2k target)")
+            except Exception as e:
+                errors.append(f"headline_8b_bs2x(bs={b2}): {e!r}")
+                note(f"FAILED 8B bs2x phase: {e!r}")
+            finally:
+                engine = None
+        # Adaptive-TTFT leg: the target-scale engine with ttft_target_ms
+        # driving the burst-depth controller, measured through the REAL
+        # scheduler — at 23 ms/step a fixed deep burst holds probes for
+        # ~740 ms (r5b measured), so target-scale TTFT stands or falls
+        # on this controller. Ships the controller's own diagnostics
+        # (fitted slope, fixed cost, depth histogram) so a miss is a
+        # reading, not a mystery.
+        if "headline_8b" in extra and not over_budget("headline_8b_ttft") \
+                and not args.skip_ttft:
+            try:
+                engine = None
+                b8 = extra["headline_8b"]["batch"]
+                bargs = eight_b_args(b8)
+                engine, _ = build_engine(
+                    bargs, "contiguous", preset=args.eight_b_preset,
+                    batch=b8, quant="int8", kv_quant="int8",
+                    ttft_target=args.ttft_target)
+                sched_tok_s = scheduler_throughput(engine, bargs)
+                reset_slots(engine)
+                t = measure_ttft_under_load(engine, bargs)
+                diag = {k: v for k, v in engine.stats().items()
+                        if k.startswith("burst_")}
+                extra["headline_8b"]["ttft_adaptive"] = {
+                    "target_ms": args.ttft_target,
+                    "scheduler_tok_s": round(sched_tok_s, 1), **t, **diag}
+                note(f"8B ttft_adaptive: p50 {t['ttft_p50_ms']} ms, "
+                     f"{sched_tok_s:.1f} tok/s (target {args.ttft_target})")
+            except Exception as e:
+                errors.append(f"headline_8b_ttft: {e!r}")
+                note(f"FAILED 8B ttft phase: {e!r}")
+            finally:
+                engine = None
         # int4 leg: the same 8B shape with 4-bit layer weights — if the
         # packed-int4 HBM layout delivers, this is the fastest
         # single-chip configuration in the ladder (~5.5 GB/step vs int8's
@@ -1027,9 +1089,11 @@ def main() -> None:
             sched_tok_s = scheduler_throughput(engine, args)
             reset_slots(engine)
             t = measure_ttft_under_load(engine, args)
+            diag = {k: v for k, v in engine.stats().items()
+                    if k.startswith("burst_")}
             extra["ttft_adaptive"] = {
                 "target_ms": args.ttft_target,
-                "scheduler_tok_s": round(sched_tok_s, 1), **t}
+                "scheduler_tok_s": round(sched_tok_s, 1), **t, **diag}
             note(f"ttft_adaptive: p50 {t['ttft_p50_ms']} ms, "
                  f"{sched_tok_s:.1f} tok/s (target {args.ttft_target} ms)")
             del engine
@@ -1274,17 +1338,27 @@ def main() -> None:
     # target-scale number separately from the (1.1B) headline ladder.
     h8 = extra.get("headline_8b", {})
     if h8.get("tok_s"):
+        ns_tok_s, ns_batch = h8["tok_s"], h8.get("batch")
+        if h8.get("bs2x_tok_s", 0) > ns_tok_s:
+            ns_tok_s, ns_batch = h8["bs2x_tok_s"], h8.get("bs2x_batch")
         extra["north_star"] = {
-            "config": (f"{h8.get('preset')} int8+kv8 bs={h8.get('batch')} "
+            "config": (f"{h8.get('preset')} int8+kv8 bs={ns_batch} "
                        f"(one chip)"),
-            "tok_s": h8["tok_s"],
-            "vs_target_2k": h8.get("vs_baseline_2k"),
+            "tok_s": ns_tok_s,
+            "vs_target_2k": round(ns_tok_s / 2000.0, 3),
             "ttft_p50_ms": h8.get("ttft_p50_ms"),
         }
         if "int4_tok_s" in h8:          # opt-in faster configuration
             extra["north_star"]["int4_tok_s"] = h8["int4_tok_s"]
             extra["north_star"]["int4_vs_target_2k"] = \
                 h8["int4_vs_target_2k"]
+        # BASELINE.md defines the baseline AT 7-8B scale — when the
+        # target-scale rung ran, IT is the headline number; the 1.1B
+        # ladder stays in extra as the small-model reference.
+        RESULT["metric"] = (f"decode_tok_s_chip ({h8.get('preset')} "
+                            f"int8+kv8, bs={ns_batch}, "
+                            f"ctx=128+{args.eight_b_steps})")
+        value = ns_tok_s
     RESULT["value"] = value
     RESULT["vs_baseline"] = round(value / 2000.0, 3)
     print(json.dumps(RESULT))

@@ -556,6 +556,27 @@ class InferenceEngine:
         self._burst_walls: dict[int, float] = {}
         self._burst_wall_stamp: dict[int, int] = {}
         self._burst_wall_n = 0
+        # Persistent slope fit + exploration (the staleness window alone
+        # is a trap: once the cap settles at one depth, every OTHER
+        # depth's wall sample ages out, the estimate degrades to the
+        # biased one-depth wall/d (per-burst fixed cost folded back in),
+        # the cap shrinks, and the controller never runs a deep burst
+        # again — a self-reinforcing spiral observed ON CHIP at 345.7
+        # tok/s vs 1475 at fixed burst 16, same target. Two repairs:
+        # the last two-depth fitted slope PERSISTS (TTL'd) so a depth
+        # aging out doesn't un-learn the fixed cost, and every
+        # _EXPLORE_EVERY idle bursts the controller runs a steady PAIR
+        # at the next-deeper compiled depth, keeping two fresh depths
+        # forever (pairs, because a wall sample only records on a
+        # steady same-depth burst pair). Exploration is throughput-free
+        # (deeper bursts amortize the fixed cost better); it costs a
+        # bounded, rare TTFT exposure one rung deeper.
+        self._fit_slope: float | None = None
+        self._fit_stamp = 0
+        self._idle_burst_i = 0
+        self._explore_pending = 0
+        self._explore_depth = 0
+        self._depth_hist: dict[int, int] = {}
         # Operator-facing gauge for /v1/api/engine-stats: EMA over ANY
         # steady same-depth burst (wall/depth, per-burst overhead
         # included) — the number an operator compares to the bench.
@@ -1708,15 +1729,34 @@ class InferenceEngine:
             fresh = {d: w[d]}
         w = fresh
         ub = min(ms / d for d, ms in w.items())
-        if len(w) == 1:
-            return ub
-        d1, d2 = sorted(w)[-2:]
-        step = (w[d2] - w[d1]) / (d2 - d1)
-        if step <= 0:
-            return ub
-        return min(step, ub)
+        if len(w) >= 2:
+            d1, d2 = sorted(w)[-2:]
+            step = (w[d2] - w[d1]) / (d2 - d1)
+            if step > 0:
+                self._fit_slope = min(step, ub)
+                self._fit_stamp = self._burst_wall_n
+                return self._fit_slope
+        # One fresh depth: the fitted slope (if it hasn't expired)
+        # still carries the fixed-cost correction — wall/d alone would
+        # re-fold C into the estimate and restart the shrink spiral.
+        if (self._fit_slope is not None
+                and self._burst_wall_n - self._fit_stamp <= self._SLOPE_TTL):
+            return min(self._fit_slope, ub)
+        return ub
 
     _BURST_WALL_WINDOW = 512
+    _SLOPE_TTL = 4096
+    _EXPLORE_EVERY = 32
+
+    def _fixed_cost_ms(self) -> float | None:
+        """Estimated per-burst fixed cost C from wall(d) = C + d·step —
+        diagnostic only (engine-stats / bench extra): on a tunneled chip
+        C is the dispatch round trip; on bare metal it is host work."""
+        if self._fit_slope is None or not self._burst_walls:
+            return None
+        d = max(self._burst_walls, key=lambda k:
+                self._burst_wall_stamp.get(k, 0))
+        return max(0.0, self._burst_walls[d] - d * self._fit_slope)
 
     def _spec_inflight_advance(self) -> int:
         """Upper bound on cache positions an in-flight speculative burst
@@ -1858,16 +1898,37 @@ class InferenceEngine:
         dispatch. Until the model has a sample, run the configured
         depth — the first bursts are the measurement."""
         if busy:
+            self._depth_hist[self.decode_burst_busy] = \
+                self._depth_hist.get(self.decode_burst_busy, 0) + 1
             return self.decode_burst_busy
+        pick = self.decode_burst
         if self.ttft_target_ms > 0:
             est = self._step_ms_estimate()
             if est:
                 cap = 0.5 * self.ttft_target_ms / est
                 fitting = [d for d in self._burst_depths if d <= cap]
-                if fitting:
-                    return min(max(fitting), self.decode_burst)
-                return self._burst_depths[0]
-        return self.decode_burst
+                pick = (min(max(fitting), self.decode_burst) if fitting
+                        else self._burst_depths[0])
+            # Exploration: a steady PAIR one compiled rung deeper, every
+            # _EXPLORE_EVERY idle bursts, keeps a second fresh depth in
+            # the wall model so the slope fit never degenerates to the
+            # C-biased one-depth form (see _step_ms_estimate).
+            if self._explore_pending > 0 and self._explore_depth > pick:
+                self._explore_pending -= 1
+                pick = self._explore_depth
+            else:
+                self._explore_pending = 0
+                self._idle_burst_i += 1
+                if pick < self.decode_burst and \
+                        self._idle_burst_i % self._EXPLORE_EVERY == 0:
+                    deeper = [d for d in self._burst_depths
+                              if pick < d <= self.decode_burst]
+                    if deeper:
+                        self._explore_depth = deeper[0]
+                        self._explore_pending = 1
+                        pick = self._explore_depth
+        self._depth_hist[pick] = self._depth_hist.get(pick, 0) + 1
+        return pick
 
     def _decode_burst(self, n_steps: int) -> list[np.ndarray]:
         """Run `n_steps` chained decode steps; tokens/lengths feed back as
@@ -2118,6 +2179,23 @@ class InferenceEngine:
             active_n = int(self.active.sum())
             if active_n:
                 out["decode_tok_s"] = round(1000.0 * active_n / gauge, 1)
+        # Burst-depth controller diagnostics (ttft_target_ms): fitted
+        # per-step slope, per-burst fixed cost, and where bursts actually
+        # ran — the fields that turn an on-chip TTFT/throughput anomaly
+        # from a guess into a reading.
+        if self.ttft_target_ms > 0:
+            est = self._step_ms_estimate()
+            if est is not None:
+                out["burst_step_ms_fit"] = round(est, 3)
+            c = self._fixed_cost_ms()
+            if c is not None:
+                out["burst_fixed_cost_ms"] = round(c, 1)
+            if self._depth_hist:
+                out["burst_depth_hist"] = dict(
+                    sorted(self._depth_hist.items()))
+            out["burst_walls_ms"] = {
+                d: round(ms, 1)
+                for d, ms in sorted(self._burst_walls.items())}
         if self.spec_k:
             out["spec_draft_len"] = self.spec_k
             if self._spec_steps_done:

@@ -347,9 +347,13 @@ def test_step_time_fit_removes_per_burst_fixed_cost():
     eng._burst_walls = {4: 48.0, 16: 72.0}
     assert eng._step_ms_estimate() == pytest.approx(2.0)
     assert eng._burst_depth(busy=False) == 24
-    # Noise guard: a non-positive slope falls back to the conservative
-    # amortized bound, never a negative/zero step time.
+    # Noise guard: a non-positive slope never feeds the cap — with a
+    # previously fitted slope on record, that slope carries over...
     eng._burst_walls = {4: 48.0, 16: 40.0}
+    assert eng._step_ms_estimate() == pytest.approx(2.0)
+    # ...and without one, the conservative amortized bound is the floor
+    # (never a negative/zero step time).
+    eng._fit_slope = None
     assert eng._step_ms_estimate() == pytest.approx(40.0 / 16)
 
 
@@ -374,6 +378,85 @@ def test_step_time_fit_ignores_stale_depths():
     # All stale -> the newest entry still provides an estimate.
     eng._burst_wall_n = 2000
     assert eng._step_ms_estimate() == pytest.approx(72.0 / 16)
+
+
+def test_fitted_slope_survives_depth_aging_out():
+    """Regression for the ON-CHIP death spiral (r5: 345.7 tok/s vs 1475
+    at fixed burst 16, same 200 ms target): once the cap settles at one
+    depth, the other depth's wall sample ages past the freshness window
+    and the estimate used to degrade to the C-biased one-depth wall/d —
+    shrinking the cap further, permanently. The fitted slope must
+    PERSIST (TTL'd) across the aging-out, holding the cap at the fitted
+    operating point."""
+    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+                            max_seq_len=64, prefill_chunk=16,
+                            dtype="float32", decode_burst=32,
+                            decode_burst_busy=4, ttft_target_ms=200.0)
+    eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
+    # Chip-like regime: step 4.5 ms, per-burst fixed cost 60 ms.
+    wall = lambda d: 60.0 + 4.5 * d
+    eng._burst_walls = {16: wall(16), 32: wall(32)}
+    eng._burst_wall_stamp = {16: 100, 32: 100}
+    eng._burst_wall_n = 100
+    assert eng._step_ms_estimate() == pytest.approx(4.5)
+    assert eng._burst_depth(busy=False) == 16          # cap 22.2
+    # Depth 32 ages out (cap ran 16 for >window bursts). Without slope
+    # persistence: est = wall(16)/16 = 8.25 -> cap 12 -> depth 8 (the
+    # first turn of the spiral). With it: est stays 4.5, depth stays 16.
+    eng._burst_wall_stamp = {16: 1000, 32: 100}
+    eng._burst_wall_n = 1000
+    assert eng._step_ms_estimate() == pytest.approx(4.5)
+    assert eng._burst_depth(busy=False) == 16
+    # The fixed-cost diagnostic reads C back out of the freshest wall.
+    assert eng._fixed_cost_ms() == pytest.approx(60.0)
+    # TTL expiry: a slope fitted thousands of samples ago no longer
+    # reflects current conditions -> conservative amortized fallback.
+    eng._burst_wall_n = 1000 + eng._SLOPE_TTL + 1
+    eng._burst_wall_stamp = {16: eng._burst_wall_n}
+    del eng._burst_walls[32]
+    assert eng._step_ms_estimate() == pytest.approx(wall(16) / 16)
+
+
+def test_explore_bursts_keep_second_depth_fresh():
+    """Every _EXPLORE_EVERY idle bursts the controller runs a steady
+    PAIR one compiled rung deeper than the cap's pick, so the slope fit
+    always has a second fresh depth (without it, exploration never
+    happens once the cap settles, and the fit starves — the other half
+    of the spiral fix)."""
+    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+                            max_seq_len=64, prefill_chunk=16,
+                            dtype="float32", decode_burst=32,
+                            decode_burst_busy=4, ttft_target_ms=200.0)
+    eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
+    eng._burst_walls = {16: 132.0, 32: 204.0}     # step 4.5, C 60
+    eng._burst_wall_stamp = {16: 10, 32: 10}
+    eng._burst_wall_n = 10
+    depths = [eng._burst_depth(busy=False)
+              for _ in range(2 * eng._EXPLORE_EVERY + 4)]
+    # Steady point is 16; the explore rung is the next compiled depth.
+    assert set(depths) == {16, 24}
+    # Explore bursts come in back-to-back pairs (a wall sample only
+    # records on a steady same-depth pair).
+    runs, cur = [], [depths[0], 0]
+    for d in depths:
+        if d == cur[0]:
+            cur[1] += 1
+        else:
+            runs.append(tuple(cur)); cur = [d, 1]
+    runs.append(tuple(cur))
+    assert all(n == 2 for d, n in runs if d == 24)
+    assert sum(n for d, n in runs if d == 24) == 4   # 2 pairs in 68 calls
+    # At the full configured depth there is nothing deeper to explore.
+    eng._burst_walls = {32: 96.0}                    # 3 ms/step amortized
+    eng._burst_wall_stamp = {32: eng._burst_wall_n}
+    eng._fit_slope = None
+    eng._explore_pending = 0
+    assert all(eng._burst_depth(busy=False) == 32
+               for _ in range(eng._EXPLORE_EVERY + 2))
+    # Diagnostics: the depth histogram saw every dispatch decision.
+    assert eng._depth_hist[24] == 4
+    assert eng._depth_hist[16] == 2 * eng._EXPLORE_EVERY
+    assert eng._depth_hist[32] == eng._EXPLORE_EVERY + 2
 
 
 def test_burst_walls_sample_any_steady_depth():
