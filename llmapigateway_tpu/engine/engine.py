@@ -1752,8 +1752,9 @@ class InferenceEngine:
         """Estimated per-burst fixed cost C from wall(d) = C + d·step —
         diagnostic only (engine-stats / bench extra): on a tunneled chip
         C is the dispatch round trip; on bare metal it is host work."""
-        if self._fit_slope is None or not self._burst_walls:
-            return None
+        if (self._fit_slope is None or not self._burst_walls
+                or self._burst_wall_n - self._fit_stamp > self._SLOPE_TTL):
+            return None                 # expired slope = fabricated C
         d = max(self._burst_walls, key=lambda k:
                 self._burst_wall_stamp.get(k, 0))
         return max(0.0, self._burst_walls[d] - d * self._fit_slope)
@@ -1898,6 +1899,11 @@ class InferenceEngine:
         dispatch. Until the model has a sample, run the configured
         depth — the first bursts are the measurement."""
         if busy:
+            # A busy interleave splits an in-progress exploration pair —
+            # its second burst would run against a busy-depth
+            # predecessor and record nothing. Cancel rather than spend
+            # the deep-burst TTFT exposure for no sample.
+            self._explore_pending = 0
             self._depth_hist[self.decode_burst_busy] = \
                 self._depth_hist.get(self.decode_burst_busy, 0) + 1
             return self.decode_burst_busy
