@@ -45,6 +45,15 @@ class LocalEngineConfig(BaseModel):
     kv_page_size: int = 256
     kv_num_pages: int = 0           # 0 → derived from max_batch_size*max_seq_len
     prefill_chunk: int = 512
+    # Max queued admissions prefilled in ONE compiled call (the
+    # scheduler groups same-bucket chunks and snaps the group size down
+    # to a compiled K rung {1,2,4,8}). Dispatch cost dominates chunk
+    # compute on a tunneled chip (measured r5: 77 ms/dispatch vs ~3 ms
+    # of 1.1B chunk compute), so a K-batch fills K-fold faster; each
+    # (bucket, K) pair costs one lazily-compiled program. 1 disables.
+    # Multihost always runs K=1 (coordinator/follower programs must
+    # stay bit-identical while followers replay per-slot frames).
+    prefill_batch: int = 8
     decode_burst: int = 8           # chained decode steps per host sync
     # Burst depth while new work is waiting (prefill interleave): deep
     # enough to amortize dispatch latency, shallow enough that admission

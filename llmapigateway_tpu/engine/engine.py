@@ -171,6 +171,12 @@ class InferenceEngine:
         self.B = engine_cfg.max_batch_size
         self.S = min(engine_cfg.max_seq_len, model_cfg.max_seq_len)
         self.prefill_chunk = engine_cfg.prefill_chunk
+        # Batched-admission K rungs (schemas.LocalEngineConfig
+        # .prefill_batch): group sizes the prefill program compiles for,
+        # snapped down from the number of same-bucket queued admissions.
+        self._prefill_k_rungs = tuple(
+            k for k in (8, 4, 2, 1)
+            if k <= max(1, min(engine_cfg.prefill_batch, self.B)))
         self.decode_burst = max(1, engine_cfg.decode_burst)
         self.decode_burst_busy = max(1, min(engine_cfg.decode_burst_busy,
                                             self.decode_burst))
@@ -667,44 +673,56 @@ class InferenceEngine:
 
         @partial(jax.jit, donate_argnums=(1,))
         def prefill_step(params, cache: llama.KVCache, tokens: jax.Array,
-                         start_len: jax.Array, slot: jax.Array,
+                         start_len: jax.Array, slots: jax.Array,
                          last_idx: jax.Array, samp_t: jax.Array,
                          samp_p: jax.Array, samp_k: jax.Array,
                          key: jax.Array
                          ) -> tuple[jax.Array, llama.KVCache]:
-            """Run one prompt chunk for one slot. tokens [1, C]. Returns
-            (first_token [replicated scalar], cache). The first token is
-            sampled INSIDE this program from the last REAL position's
-            logits row — through a remote-device link every extra compiled
-            call in the TTFT path costs a full dispatch round trip (~64 ms
-            on the axon tunnel), so prefill→row-fetch→sample-one (3 calls)
-            is folded into one. Fetching anything here would also be a
-            global op every process of a multi-host deployment must join;
-            followers run the same program with dummy sampling inputs and
-            ignore the token."""
-            # Slice this slot's cache rows: [L, 1, KV, S, Dh]. tree.map
-            # covers the int8 {"q","s"} cache leaves uniformly.
-            def row_of(side):
+            """Run one prompt chunk for each of K slots. tokens [K, C],
+            start_len/slots/last_idx/samp_* [K]. Returns (first_tokens
+            [K, replicated], cache). K=1 is the single-request path;
+            K>1 is BATCHED admission: on a tunneled chip one dispatch
+            costs ~50-75 ms while a 1.1B chunk computes in ~3 ms
+            (BENCH_SELF_r5b: 40 slots filled at 77 ms/chunk), so K
+            queued prefills in one program cut fill time ~K-fold. The
+            first token is sampled INSIDE this program from each row's
+            last REAL position — prefill→row-fetch→sample-one folded
+            into one dispatch, as before. Per-k cache rows move via
+            unrolled dynamic slices (NOT a gather: the B axis may be
+            sharded over `data`, and dynamic_slice is the op GSPMD
+            already partitions correctly for the K=1 path).
+            Multihost followers always run K=1 (see _step): batched
+            grouping is a compile-shape choice, and coordinator/follower
+            programs must stay bit-identical."""
+            K = tokens.shape[0]
+
+            def rows_of(side):
                 return jax.tree.map(
-                    lambda a: jax.lax.dynamic_slice_in_dim(a, slot, 1,
-                                                           axis=1), side)
-            row_cache = llama.KVCache(k=row_of(cache.k), v=row_of(cache.v))
-            lengths = start_len[None]
+                    lambda a: jnp.concatenate(
+                        [jax.lax.dynamic_slice_in_dim(a, slots[k], 1,
+                                                      axis=1)
+                         for k in range(K)], axis=1), side)
+            row_cache = llama.KVCache(k=rows_of(cache.k),
+                                      v=rows_of(cache.v))
             logits, row_cache = prefill_forward(
-                params, c, tokens, lengths, row_cache)
-            new_k = jax.tree.map(
-                lambda full, row: jax.lax.dynamic_update_slice_in_dim(
-                    full, row, slot, axis=1), cache.k, row_cache.k)
-            new_v = jax.tree.map(
-                lambda full, row: jax.lax.dynamic_update_slice_in_dim(
-                    full, row, slot, axis=1), cache.v, row_cache.v)
-            row = jax.lax.with_sharding_constraint(
-                jax.lax.dynamic_index_in_dim(logits[0], last_idx, 0,
-                                             keepdims=False), replicated)
-            samp = SamplingParams(temperature=samp_t[None],
-                                  top_p=samp_p[None], top_k=samp_k[None])
+                params, c, tokens, start_len, row_cache)
+
+            def scatter(full, rows):
+                for k in range(K):
+                    full = jax.lax.dynamic_update_slice_in_dim(
+                        full, jax.lax.dynamic_slice_in_dim(
+                            rows, k, 1, axis=1), slots[k], axis=1)
+                return full
+            new_k = jax.tree.map(scatter, cache.k, row_cache.k)
+            new_v = jax.tree.map(scatter, cache.v, row_cache.v)
+            rows = jax.lax.with_sharding_constraint(
+                jnp.take_along_axis(
+                    logits, last_idx[:, None, None], axis=1)[:, 0, :],
+                replicated)
+            samp = SamplingParams(temperature=samp_t, top_p=samp_p,
+                                  top_k=samp_k)
             first = jax.lax.with_sharding_constraint(
-                sample(row[None], samp, key)[0], replicated)
+                sample(rows, samp, key), replicated)
             return first, llama.KVCache(k=new_k, v=new_v)
 
         def one_step(params, cache: llama.KVCache, tokens: jax.Array,
@@ -846,24 +864,30 @@ class InferenceEngine:
         @partial(jax.jit, donate_argnums=(1,))
         def prefill_step(params, cache: PagedKVCache, table: jax.Array,
                          tokens: jax.Array, start_len: jax.Array,
-                         slot: jax.Array, last_idx: jax.Array,
+                         slots: jax.Array, last_idx: jax.Array,
                          samp_t: jax.Array, samp_p: jax.Array,
                          samp_k: jax.Array, key: jax.Array
                          ) -> tuple[jax.Array, PagedKVCache]:
-            """One prompt chunk for one slot. tokens [1, C]; the pool is
-            global, so unlike the dense path there is no per-slot row slice
-            — the slot's page-table row does the routing. Returns (first
-            sampled token, cache) — sampling folded in, see dense twin."""
-            row = jax.lax.dynamic_slice_in_dim(table, slot, 1, axis=0)
-            logits, cache = call_forward(params, cache, row, tokens,
-                                         start_len[None], prefill=True)
-            out = jax.lax.with_sharding_constraint(
-                jax.lax.dynamic_index_in_dim(logits[0], last_idx, 0,
-                                             keepdims=False), replicated)
-            samp = SamplingParams(temperature=samp_t[None],
-                                  top_p=samp_p[None], top_k=samp_k[None])
+            """One prompt chunk for each of K slots (dense twin's batched
+            admission — see its docstring). tokens [K, C]; the pool is
+            global, so unlike the dense path there is no per-slot cache
+            slice — each slot's page-table row does the routing, and the
+            K rows are sliced unrolled (same GSPMD-partitioned op as the
+            K=1 path)."""
+            K = tokens.shape[0]
+            rows_tbl = jnp.concatenate(
+                [jax.lax.dynamic_slice_in_dim(table, slots[k], 1, axis=0)
+                 for k in range(K)], axis=0)
+            logits, cache = call_forward(params, cache, rows_tbl, tokens,
+                                         start_len, prefill=True)
+            rows = jax.lax.with_sharding_constraint(
+                jnp.take_along_axis(
+                    logits, last_idx[:, None, None], axis=1)[:, 0, :],
+                replicated)
+            samp = SamplingParams(temperature=samp_t, top_p=samp_p,
+                                  top_k=samp_k)
             first = jax.lax.with_sharding_constraint(
-                sample(out[None], samp, key)[0], replicated)
+                sample(rows, samp, key), replicated)
             return first, PagedKVCache(k=cache.k, v=cache.v)
 
         def one_step(params, cache: PagedKVCache, table: jax.Array,
@@ -1144,15 +1168,44 @@ class InferenceEngine:
 
         # 2. Advance each pending prefill by ONE chunk (chunked-prefill
         #    interleave: a long prompt never blocks decode for more than one
-        #    chunk — SURVEY.md §7 hard part (6)).
+        #    chunk — SURVEY.md §7 hard part (6)). Same-bucket chunks group
+        #    into ONE compiled call (batched admission — dispatch cost
+        #    dominates chunk compute, see _prefill_chunk_group), the group
+        #    size snapped down to a compiled K rung. Multihost runs K=1:
+        #    followers replay per-slot PREFILL frames, and coordinator/
+        #    follower programs must stay bit-identical. The seq-sharded
+        #    engine also runs K=1 (its prefill is one whole-prompt ring
+        #    program; admission concurrency is not its regime).
+        eligible: list[GenRequest] = []
         for slot, req in list(self._prefilling.items()):
             if req.cancelled:
                 self._finish(req, "cancelled", emit=False)
                 continue
-            prompt_done = await asyncio.to_thread(self._prefill_one_chunk, req)
-            if prompt_done:
-                del self._prefilling[slot]
-                self._emit_token(req)      # first token, sampled off prefill
+            eligible.append(req)
+        batch_k = (1 if self._bridge.enabled or self.seq_n > 1
+                   else self._prefill_k_rungs[0])
+        if batch_k <= 1 or len(eligible) <= 1:
+            for req in eligible:
+                prompt_done = await asyncio.to_thread(
+                    self._prefill_one_chunk, req)
+                if prompt_done:
+                    del self._prefilling[req.slot]
+                    self._emit_token(req)  # first token, sampled off prefill
+        else:
+            groups: dict[int, list[GenRequest]] = {}
+            for req in eligible:
+                pos = req.prefill_pos
+                ch = min(self.prefill_chunk, len(req.prompt_ids) - pos)
+                bucket = min(_bucket(ch, self.prefill_chunk), self.S - pos)
+                groups.setdefault(bucket, []).append(req)
+            for reqs in groups.values():
+                for batch in self.prefill_groups(reqs):
+                    dones = await asyncio.to_thread(
+                        self._prefill_chunk_group, batch)
+                    for req, prompt_done in zip(batch, dones):
+                        if prompt_done:
+                            del self._prefilling[req.slot]
+                            self._emit_token(req)
 
         # 3. A decode burst for all slots in decode phase. Burst depth adapts:
         #    stay shallow when new work is waiting (prefill responsiveness →
@@ -1317,90 +1370,145 @@ class InferenceEngine:
     def _prefill_one_chunk(self, req: GenRequest) -> bool:
         """Run one prompt chunk; returns True when the prompt is complete
         (first token sampled and slot armed for decode)."""
-        slot = req.slot
-        ids = req.prompt_ids
-        pos = req.prefill_pos
-        if pos == 0:
-            self.lengths[slot] = 0
-            self.active[slot] = False
-        chunk = np.asarray(ids[pos:pos + self.prefill_chunk], np.int32)
-        if self._swa_ring_pages:
-            # Map the pages this chunk writes by recycling pages wholly
-            # below the chunk's window floor (no in-flight margin: a
-            # prefilling slot has no decode burst of its own in flight,
-            # and cross-slot bursts touch only their own table rows).
-            page = self.allocator.page_size
-            dead = max(0, pos - self.model_cfg.sliding_window + 1) // page
-            if self.allocator.ensure_mapped(
-                    slot, (pos + len(chunk) - 1) // page, dead):
-                self._table_dirty = True
-        if self.fault_plan:
-            self.fault_plan.on_prefill()
-        self._spec_hist_chunk(slot, pos, chunk)
-        self._bridge.publish_prefill(slot, pos, chunk,
-                                     table=self._table_to_publish())
+        return self._prefill_chunk_group([req])[0]
+
+    def prefill_groups(self, items: list) -> list[list]:
+        """Split ``items`` into batched-prefill group sizes, snapping each
+        group DOWN to a compiled K rung. The ONE copy of the snapping
+        policy: the scheduler's grouper and the bench's fill loop both
+        call it, so the bench always warms/times exactly the programs
+        serving admission runs."""
+        out, i = [], 0
+        while i < len(items):
+            k = next(r for r in self._prefill_k_rungs if r <= len(items) - i)
+            out.append(items[i:i + k])
+            i += k
+        return out
+
+    def _prefill_chunk_group(self, reqs: list[GenRequest]) -> list[bool]:
+        """Advance each request by one prompt chunk in ONE compiled call
+        (K=1 is the single-request path). Batching cuts admission's
+        dominant cost on a tunneled chip — the per-dispatch round trip
+        (BENCH_SELF_r5b: 77 ms/chunk against ~3 ms of 1.1B chunk
+        compute) — K queued prefills pay it once. The scheduler's
+        grouper guarantees every request here shares one compile bucket
+        and that multihost runs K=1 only (followers replay per-slot
+        PREFILL frames; coordinator/follower programs must stay
+        bit-identical). Returns per-request prompt-complete flags."""
+        slots, poss, chunks, samps = [], [], [], []
+        for req in reqs:
+            slot = req.slot
+            ids = req.prompt_ids
+            pos = req.prefill_pos
+            if pos == 0:
+                self.lengths[slot] = 0
+                self.active[slot] = False
+            chunk = np.asarray(ids[pos:pos + self.prefill_chunk], np.int32)
+            if self._swa_ring_pages:
+                # Map the pages this chunk writes by recycling pages wholly
+                # below the chunk's window floor (no in-flight margin: a
+                # prefilling slot has no decode burst of its own in flight,
+                # and cross-slot bursts touch only their own table rows).
+                page = self.allocator.page_size
+                dead = max(0, pos - self.model_cfg.sliding_window + 1) \
+                    // page
+                if self.allocator.ensure_mapped(
+                        slot, (pos + len(chunk) - 1) // page, dead):
+                    self._table_dirty = True
+            if self.fault_plan:
+                self.fault_plan.on_prefill()
+            self._spec_hist_chunk(slot, pos, chunk)
+            self._bridge.publish_prefill(slot, pos, chunk,
+                                         table=self._table_to_publish())
+            slots.append(slot)
+            poss.append(pos)
+            chunks.append(chunk)
+            samps.append((req.temperature, req.top_p, req.top_k))
         self._rng, key = jax.random.split(self._rng)
         first, self.cache = self._exec_prefill(
-            slot, pos, chunk,
-            samp=(req.temperature, req.top_p, req.top_k), key=key)
-        req.prefill_pos = pos + len(chunk)
-        if req.prefill_pos < len(ids):
-            return False
+            slots, poss, chunks, samp=samps, key=key)
+        done: list[bool] = []
+        first_np: np.ndarray | None = None
+        for i, req in enumerate(reqs):
+            req.prefill_pos = poss[i] + len(chunks[i])
+            if req.prefill_pos < len(req.prompt_ids):
+                done.append(False)
+                continue
+            # Prompt complete: the first token was sampled inside the
+            # prefill program (see prefill_step) — ONE host fetch for the
+            # whole group completes the TTFT path. Followers of a
+            # multi-host mesh ran the same program with dummy sampling
+            # inputs and never fetch; the real token reaches them inside
+            # the next decode burst's broadcast state.
+            if first_np is None:
+                first_np = np.asarray(first)
+            first_id = int(first_np[i])
+            req.generated.append(first_id)
+            req.t_first_token = time.monotonic()
+            self.lengths[req.slot] = len(req.prompt_ids)
+            self.last_token[req.slot] = first_id
+            # (Token history for prompt-lookup drafting is maintained per
+            # CHUNK above — identically on multihost followers, so every
+            # process's hist mirror stays bit-identical at all times; the
+            # first generated token is the input at P, written by the
+            # spec step that consumes it.)
+            self.active[req.slot] = True
+            self.samp_temperature[req.slot] = req.temperature
+            self.samp_top_p[req.slot] = req.top_p
+            self.samp_top_k[req.slot] = req.top_k
+            self._d_dirty = True
+            done.append(True)
+        return done
 
-        # Prompt complete: the first token was sampled inside the prefill
-        # program (see prefill_step) — ONE host fetch completes the TTFT
-        # path. Followers of a multi-host mesh ran the same program with
-        # dummy sampling inputs and never fetch; the real token reaches
-        # them inside the next decode burst's broadcast state.
-        first_id = int(first)
-        req.generated.append(first_id)
-        req.t_first_token = time.monotonic()
-        self.lengths[slot] = len(ids)
-        self.last_token[slot] = first_id
-        # (Token history for prompt-lookup drafting is maintained per
-        # CHUNK in _prefill_one_chunk — identically on multihost
-        # followers, so every process's hist mirror stays bit-identical
-        # at all times; the first generated token is the input at P,
-        # written by the spec step that consumes it.)
-        self.active[slot] = True
-        self.samp_temperature[slot] = req.temperature
-        self.samp_top_p[slot] = req.top_p
-        self.samp_top_k[slot] = req.top_k
-        self._d_dirty = True
-        return True
-
-    def _exec_prefill(self, slot: int, pos: int, chunk: np.ndarray,
-                      samp: tuple[float, float, int] | None = None,
-                      key: jax.Array | None = None):
+    def _exec_prefill(self, slot, pos, chunk,
+                      samp=None, key: jax.Array | None = None):
         """The one compiled-prefill call — identical on coordinator and
         followers (np/uncommitted inputs are auto-replicated, so the same
         call works single-process and across a multi-host mesh; followers
         pass no sampling state and ignore the sampled token — the cache
-        update is input-value-identical either way). The compile bucket is
-        derived here, from (pos, len(chunk)) and engine config, so
-        coordinator/followers/bench can never disagree on it. Clamped so
-        pos+bucket never exceeds the cache extent S: XLA clamps
-        dynamic_update_slice starts, so an overrunning padded chunk would
-        silently shift and corrupt earlier KV entries. (Paged layout:
-        out-of-range pad positions land on the trash page.)
-        Returns (first_token [replicated scalar device array], cache)."""
-        bucket = min(_bucket(len(chunk), self.prefill_chunk), self.S - pos)
+        update is input-value-identical either way).
+
+        ``slot``/``pos``/``chunk``/``samp`` are scalars-and-one-chunk for
+        the K=1 path, or equal-length lists for BATCHED admission (the
+        scheduler's grouper). The compile bucket is derived here, from
+        chunk lengths and engine config, so coordinator/followers/bench
+        can never disagree on it; batches share one bucket (the grouper
+        only batches same-bucket chunks). Clamped so pos+bucket never
+        exceeds the cache extent S for ANY row: XLA clamps
+        dynamic_update_slice starts, so an overrunning padded chunk
+        would silently shift and corrupt earlier KV entries. (Paged
+        layout: out-of-range pad positions land on the trash page.)
+        Returns (first_tokens [K, replicated device array], cache)."""
+        single = np.isscalar(slot) or isinstance(slot, (int, np.integer))
+        slots = [slot] if single else list(slot)
+        poss = [pos] if single else list(pos)
+        chunks = [chunk] if single else list(chunk)
+        samps = ([samp] if single else list(samp)) if samp is not None \
+            else [(0.0, 1.0, 0)] * len(slots)
+        K = len(slots)
+        bucket = min(_bucket(max(len(ch) for ch in chunks),
+                             self.prefill_chunk),
+                     self.S - max(poss))
         if self.seq_n > 1:
             # Ring attention shards the chunk's T dim over `seq`: round the
             # bucket up to a multiple of the axis size (pads are causally
             # invisible to real positions; their K/V lands beyond `lengths`
             # in the documented undefined zone).
-            bucket = min(-(-bucket // self.seq_n) * self.seq_n, self.S - pos)
-        padded = np.zeros((1, bucket), np.int32)
-        padded[:, :len(chunk)] = chunk
+            bucket = min(-(-bucket // self.seq_n) * self.seq_n,
+                         self.S - max(poss))
+        padded = np.zeros((K, bucket), np.int32)
+        for i, ch in enumerate(chunks):
+            padded[i, :len(ch)] = ch
         table = (self._device_table(),) if self.paged else ()
-        temp, top_p, top_k = samp if samp is not None else (0.0, 1.0, 0)
         if key is None:
             key = _DUMMY_KEY()
         return self._prefill_fn(
-            self.params, self.cache, *table, padded, np.int32(pos),
-            np.int32(slot), np.int32(len(chunk) - 1), np.float32(temp),
-            np.float32(top_p), np.int32(top_k), key)
+            self.params, self.cache, *table, padded,
+            np.asarray(poss, np.int32), np.asarray(slots, np.int32),
+            np.asarray([len(ch) - 1 for ch in chunks], np.int32),
+            np.asarray([s[0] for s in samps], np.float32),
+            np.asarray([s[1] for s in samps], np.float32),
+            np.asarray([s[2] for s in samps], np.int32), key)
 
     def _exec_decode(self, n_steps: int, state: dict) -> list[np.ndarray]:
         """Run a burst from broadcast-packed host state (multihost path) —

@@ -66,6 +66,77 @@ async def test_concurrent_batching(engine):
     assert solo.generated == reqs[0].generated
 
 
+def test_prefill_group_matches_single_calls():
+    """One K=2 batched-prefill program call must leave the engine in the
+    same state as two K=1 calls (same cache, mirrors, first tokens) —
+    the correctness that licenses batched admission's ~K-fold fill
+    speedup (a dispatch costs ~50-75 ms on a tunneled chip against
+    ~3 ms of chunk compute, BENCH_SELF_r5b). Driven at the
+    _prefill_chunk_group level so the grouping is deterministic, not
+    scheduler-timing-dependent."""
+    import numpy as np
+
+    def build():
+        cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=4,
+                                max_seq_len=128, prefill_chunk=16,
+                                dtype="float32", decode_burst=4)
+        return InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
+
+    def reqs_for(eng):
+        out = []
+        for slot, text in ((0, "batched admission parity alpha"),
+                           (2, "a different second prompt beta")):
+            req = GenRequest(prompt_ids=eng.tokenizer.encode(text),
+                             max_tokens=4)
+            req.slot = slot
+            req.prefill_pos = 0
+            out.append(req)
+        return out
+
+    eng_b, eng_s = build(), build()
+    rb, rs = reqs_for(eng_b), reqs_for(eng_s)
+    done_b = eng_b._prefill_chunk_group(rb)      # one K=2 program
+    done_s = [eng_s._prefill_chunk_group([r])[0] for r in rs]  # two K=1
+    assert done_b == done_s
+    for a, b in zip(rb, rs):
+        assert a.generated == b.generated        # first tokens
+    np.testing.assert_array_equal(eng_b.lengths, eng_s.lengths)
+    np.testing.assert_array_equal(eng_b.active, eng_s.active)
+    for side in ("k", "v"):
+        for la, lb in zip(jax.tree.leaves(getattr(eng_b.cache, side)),
+                          jax.tree.leaves(getattr(eng_s.cache, side))):
+            np.testing.assert_allclose(np.asarray(la), np.asarray(lb),
+                                       rtol=1e-6, atol=1e-6)
+
+
+async def test_batched_admission_matches_sequential():
+    """End-to-end: concurrent submissions (batched admission engages
+    opportunistically when same-bucket prefills are queued together)
+    produce the exact greedy tokens of one-at-a-time admission."""
+    prompts = [f"batched admission parity {i} " * 2 for i in range(4)]
+
+    cfg1 = LocalEngineConfig(preset="tiny-test", max_batch_size=4,
+                             max_seq_len=128, prefill_chunk=16,
+                             dtype="float32", decode_burst=4,
+                             prefill_batch=1)
+    eng1 = InferenceEngine(cfg1, devices=[jax.devices("cpu")[0]])
+    try:
+        want = [(await _generate(eng1, p, max_tokens=6)).generated
+                for p in prompts]
+    finally:
+        await eng1.stop()
+
+    cfg = cfg1.model_copy(update={"prefill_batch": 4})
+    eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
+    try:
+        reqs = await asyncio.gather(*[
+            _generate(eng, p, max_tokens=6) for p in prompts])
+    finally:
+        await eng.stop()
+    for req, tokens in zip(reqs, want):
+        assert req.generated == tokens
+
+
 async def test_pipelined_bursts_match_sync_engine():
     """Lag-one burst pipelining (decode_burst > 1) must produce the exact
     greedy tokens of a fully synchronous engine (decode_burst=1), across

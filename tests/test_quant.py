@@ -217,7 +217,7 @@ def test_checkpoint_load_quantizes_on_host(tmp_path):
 
     first, engine.cache = engine._exec_prefill(
         0, 0, np.arange(1, 9, dtype=np.int32))
-    assert 0 <= int(np.asarray(first)) < 128
+    assert 0 <= int(np.asarray(first)[0]) < 128
 
 
 def test_tied_head_quant_fidelity_and_structure():
@@ -280,7 +280,7 @@ def test_checkpoint_tied_head_quantizes_on_device(tmp_path):
 
     first, engine.cache = engine._exec_prefill(
         0, 0, np.arange(1, 9, dtype=np.int32))
-    assert 0 <= int(np.asarray(first)) < 128
+    assert 0 <= int(np.asarray(first)[0]) < 128
 
 
 def test_moe_expert_quant_fidelity():

@@ -37,7 +37,7 @@ import subprocess
 import sys
 import textwrap
 
-TIMEOUT_S = 180
+TIMEOUT_S = int(os.environ.get("REPRO_TIMEOUT_S", "180"))
 
 COMMON = textwrap.dedent("""
     import os, jax, json, sys
