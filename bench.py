@@ -270,7 +270,10 @@ def fill_and_time_decode(engine, args, steps: int | None = None) -> dict:
     # Warm writes land in low slots / the paged trash page and are
     # overwritten by the fill.
     t0 = time.monotonic()
-    for k in sorted({len(g) for g in groups}):
+    # K=1 is always warmed: TTFT probes admit through the single-request
+    # path, and an uncompiled (bucket, K=1) program would land its
+    # compile inside a probe's TTFT measurement.
+    for k in sorted({1, *(len(g) for g in groups)}):
         pos = 0
         while pos < len(prompt):
             chunk = prompt[pos:pos + engine.prefill_chunk]
@@ -1119,38 +1122,6 @@ def main() -> None:
             errors.append(f"ttft_adaptive: {e!r}")
             note(f"FAILED ttft_adaptive phase: {e!r}")
 
-    # -- phase 4: mid-size preset (MFU-vs-width rung) ------------------------
-    if args.second_preset and not over_budget("second_preset"):
-        try:
-            engine = None
-            engine, init_s = build_engine(args, "contiguous",
-                                          preset=args.second_preset)
-            r = fill_and_time_decode(engine, args, steps=args.second_steps)
-            r["preset"] = args.second_preset
-            r["init_s"] = init_s
-            extra["second_preset"] = r
-            del engine
-        except Exception as e:
-            errors.append(f"second_preset: {e!r}")
-            note(f"FAILED second-preset phase: {e!r}")
-
-    # -- phase 4b: batch-scaling rung (same model, bs=32) --------------------
-    if (args.scale_batch and args.scale_batch != args.batch
-            and not over_budget("batch_scale")):
-        try:
-            engine = None
-            engine, init_s = build_engine(args, "contiguous",
-                                          batch=args.scale_batch)
-            r = fill_and_time_decode(engine, args, steps=args.scale_steps)
-            extra["batch_scale"] = {
-                "batch": args.scale_batch, "tok_s": r["tok_s"],
-                "ms_per_decode_step": r["ms_per_decode_step"],
-                "mfu": r["mfu"], "hbm_gbps": r["hbm_gbps"]}
-            del engine
-        except Exception as e:
-            errors.append(f"batch_scale: {e!r}")
-            note(f"FAILED batch-scale phase: {e!r}")
-
     # -- phase 4f: long-context rung (bf16 KV vs int8 KV) --------------------
     # At ctx ~2k+ the live KV bytes rival the weight bytes, so this is the
     # regime where kv_quant's bandwidth halving shows up as tok/s (at the
@@ -1235,6 +1206,38 @@ def main() -> None:
             note(f"FAILED SWA phase: {e!r}")
         finally:
             engine = None           # a failed leg must not hold 7B of HBM
+
+    # -- phase 4: mid-size preset (MFU-vs-width rung) ------------------------
+    if args.second_preset and not over_budget("second_preset"):
+        try:
+            engine = None
+            engine, init_s = build_engine(args, "contiguous",
+                                          preset=args.second_preset)
+            r = fill_and_time_decode(engine, args, steps=args.second_steps)
+            r["preset"] = args.second_preset
+            r["init_s"] = init_s
+            extra["second_preset"] = r
+            del engine
+        except Exception as e:
+            errors.append(f"second_preset: {e!r}")
+            note(f"FAILED second-preset phase: {e!r}")
+
+    # -- phase 4b: batch-scaling rung (same model, bs=32) --------------------
+    if (args.scale_batch and args.scale_batch != args.batch
+            and not over_budget("batch_scale")):
+        try:
+            engine = None
+            engine, init_s = build_engine(args, "contiguous",
+                                          batch=args.scale_batch)
+            r = fill_and_time_decode(engine, args, steps=args.scale_steps)
+            extra["batch_scale"] = {
+                "batch": args.scale_batch, "tok_s": r["tok_s"],
+                "ms_per_decode_step": r["ms_per_decode_step"],
+                "mfu": r["mfu"], "hbm_gbps": r["hbm_gbps"]}
+            del engine
+        except Exception as e:
+            errors.append(f"batch_scale: {e!r}")
+            note(f"FAILED batch-scale phase: {e!r}")
 
     # -- phase 4c: speculative decoding rung ---------------------------------
     if args.spec_draft and not over_budget("speculative"):

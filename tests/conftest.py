@@ -123,6 +123,8 @@ def pytest_pyfunc_call(pyfuncitem):
 # so a genuinely intermittent failure in any other test is never masked.
 _PARITY_RERUN_TESTS = {
     # test_engine.py
+    "test_batched_admission_matches_sequential",
+    "test_prefill_group_matches_single_calls",
     "test_concurrent_batching", "test_deterministic_greedy",
     "test_pipelined_bursts_match_sync_engine",
     "test_pipelined_slot_reuse_no_token_bleed",
