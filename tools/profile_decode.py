@@ -45,12 +45,12 @@ def build(args):
         p = llama.init_params(c, k, dtype=jnp.bfloat16)
         if args.quant:
             from llmapigateway_tpu.models.quant import quantize_tree
-            p = quantize_tree(p, c)
+            p = quantize_tree(p, c, args.quant)
         return p
     params = jax.jit(init)(key)
     jax.block_until_ready(params)
     note(f"params on device in {time.monotonic() - t0:.1f}s"
-         + (" (int8 weights)" if args.quant else ""))
+         + (f" ({args.quant} weights)" if args.quant else ""))
     cache = llama.KVCache.create(c, args.batch, args.seq,
                                  kv_quant="int8" if args.kv_quant else "")
     return c, params, cache
@@ -152,6 +152,77 @@ def time_variant(c, params, cache, args, variant, attention_fn=None):
     return ms_step, cache
 
 
+def time_weights_stream(c, params, args):
+    """Pure weight-streaming roofline probe: a scan over the stacked
+    layers running ONLY the seven projection dots (plus the lm_head) at
+    the decode step's exact shapes, no attention/cache/norms/sampling.
+    The measured ms/step is the best step time these dots can achieve
+    on this chip — full-step minus this is glue; this minus
+    bytes/HBM-peak is the dots' own streaming inefficiency (the lever
+    fused/layout work would pull). Every projection output feeds the
+    carry (or an aux scalar) so XLA cannot dead-code any weight read."""
+    from llmapigateway_tpu.models.quant import head_matmul, is_quantized, mm
+
+    B = args.batch
+
+    @jax.jit
+    def stream_burst(params, x0):
+        def one_pass(x):
+            def body(carry, lp):
+                h, aux = carry
+                q = mm(h, lp["wq"])
+                k = mm(h, lp["wk"])
+                v = mm(h, lp["wv"])
+                o = mm(q, lp["wo"])
+                g = mm(h, lp["wg"])
+                u = mm(h, lp["wu"])
+                d = mm(g * u, lp["wd"])
+                return (h + o + d, aux + k.sum() + v.sum()), None
+            (h, aux), _ = jax.lax.scan(body, (x, jnp.float32(0)),
+                                       params["layers"])
+            head = params.get("lm_head", params.get("lm_head_q8",
+                                                    params["embed"]))
+            logits = head_matmul(h[:, None, :], head)
+            return h, aux + logits.sum()
+
+        # Burst the passes like the decode variants do — a single pass
+        # is shorter than the tunnel's per-dispatch cost and would time
+        # the dispatch, not the dots. The carry feeds forward so no
+        # pass can be elided or overlapped away.
+        def step(carry, _):
+            x, tot = carry
+            h, s = one_pass(x)
+            return ((h * 1e-3).astype(x.dtype), tot + s), None
+        (x, tot), _ = jax.lax.scan(step, (x0, jnp.float32(0)), None,
+                                   length=args.burst)
+        return tot
+
+    x = jnp.ones((B, c.d_model), jnp.bfloat16)
+    out = stream_burst(params, x)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(args.reps):
+        t0 = time.monotonic()
+        jax.block_until_ready(stream_burst(params, x))
+        best = min(best, time.monotonic() - t0)
+    best = best / args.burst
+
+    def leaf_bytes(w):
+        if is_quantized(w):
+            return w["q"].nbytes + w["s"].nbytes
+        return w.nbytes
+    keys = ("wq", "wk", "wv", "wo", "wg", "wu", "wd")
+    nbytes = sum(leaf_bytes(params["layers"][k]) for k in keys)
+    head = params.get("lm_head", params.get("lm_head_q8",
+                                            params["embed"]))
+    nbytes += leaf_bytes(head)
+    ms = 1000.0 * best
+    gbps = nbytes / best / 1e9
+    note(f"{'weights_stream':10s}: {ms:8.3f} ms/step   "
+         f"({nbytes / 1e9:.2f} GB of weights -> {gbps:.0f} GB/s achieved)")
+    return ms
+
+
 def time_sort_alone(args, V):
     x = jax.random.normal(jax.random.PRNGKey(0), (args.batch, V), jnp.float32)
 
@@ -186,8 +257,9 @@ def main():
                     "noattn,nomlp")
     ap.add_argument("--pallas", action="store_true",
                     help="also run `full` with the pallas attention_fn")
-    ap.add_argument("--quant", action="store_true",
-                    help="int8 weights (models/quant.py)")
+    ap.add_argument("--quant", nargs="?", const="int8", default="",
+                    choices=("", "int8", "int4"),
+                    help="weight quantization (bare flag = int8)")
     ap.add_argument("--kv-quant", action="store_true",
                     help="int8 KV cache")
     args = ap.parse_args()
@@ -203,6 +275,7 @@ def main():
         results["pallas"], cache = time_variant(
             c, params, cache, args, "full",
             attention_fn=make_cache_attention_fn())
+    results["weights_stream"] = time_weights_stream(c, params, args)
     results["sort_alone"] = time_sort_alone(args, c.vocab_size)
 
     note("\n--- attribution (ms/step) ---")
@@ -211,7 +284,7 @@ def main():
         for k, v in results.items():
             if k == "full":
                 note(f"full step          : {f:8.3f}")
-            elif k in ("sort_alone", "pallas"):
+            elif k in ("sort_alone", "pallas", "weights_stream"):
                 note(f"{k:19s}: {v:8.3f}")
             else:
                 note(f"delta full-{k:8s}: {f - v:8.3f}")
