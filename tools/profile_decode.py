@@ -223,6 +223,74 @@ def time_weights_stream(c, params, args):
     return ms
 
 
+def time_weights_stream_fused(c, params, args):
+    """The same weight bytes as :func:`time_weights_stream`, streamed
+    through FUSED projections — wqkv = [wq|wk|wv] and wgu = [wg|wu]
+    concatenated on the output axis (6 dots/layer instead of 7, wider
+    contiguous streams). The delta vs the unfused probe is the entire
+    case for (or against) building fused projections into the model:
+    if the dots stream at the same rate either way, the model feature
+    buys nothing and is not built."""
+    from llmapigateway_tpu.models.quant import head_matmul, is_quantized, mm
+
+    B = args.batch
+    lay = params["layers"]
+
+    def cat(ws):
+        if is_quantized(ws[0]):
+            return {"q": jnp.concatenate([w["q"] for w in ws], axis=-1),
+                    "s": jnp.concatenate([w["s"] for w in ws], axis=-1)}
+        return jnp.concatenate(ws, axis=-1)
+
+    fused = {"wqkv": cat([lay["wq"], lay["wk"], lay["wv"]]),
+             "wo": lay["wo"], "wgu": cat([lay["wg"], lay["wu"]]),
+             "wd": lay["wd"]}
+    fused = jax.tree.map(jnp.asarray, fused)
+    jax.block_until_ready(fused)
+
+    def out_width(w):
+        return (w["q"] if is_quantized(w) else w).shape[-1]
+    D = out_width(lay["wq"])        # q slice of the fused z
+    F = out_width(lay["wg"])        # gate slice of the fused gu
+
+    @jax.jit
+    def stream_burst(fused, head, x0):
+        def one_pass(x):
+            def body(carry, lp):
+                h, aux = carry
+                z = mm(h, lp["wqkv"])
+                q = z[:, :D]
+                o = mm(q, lp["wo"])
+                gu = mm(h, lp["wgu"])
+                d = mm(gu[:, :F] * gu[:, F:], lp["wd"])
+                return (h + o + d, aux + z[:, D:].sum()), None
+            (h, aux), _ = jax.lax.scan(body, (x, jnp.float32(0)), fused)
+            logits = head_matmul(h[:, None, :], head)
+            return h, aux + logits.sum()
+
+        def step(carry, _):
+            x, tot = carry
+            h, s = one_pass(x)
+            return ((h * 1e-3).astype(x.dtype), tot + s), None
+        (x, tot), _ = jax.lax.scan(step, (x0, jnp.float32(0)), None,
+                                   length=args.burst)
+        return tot
+
+    head = params.get("lm_head", params.get("lm_head_q8", params["embed"]))
+    x = jnp.ones((B, D), jnp.bfloat16)
+    out = stream_burst(fused, head, x)
+    jax.block_until_ready(out)
+    best = float("inf")
+    for _ in range(args.reps):
+        t0 = time.monotonic()
+        jax.block_until_ready(stream_burst(fused, head, x))
+        best = min(best, time.monotonic() - t0)
+    ms = 1000.0 * best / args.burst
+    note(f"{'fused_stream':10s}: {ms:8.3f} ms/step   "
+         f"(wqkv+wgu concatenated, 6 dots/layer)")
+    return ms
+
+
 def time_sort_alone(args, V):
     x = jax.random.normal(jax.random.PRNGKey(0), (args.batch, V), jnp.float32)
 
@@ -276,6 +344,8 @@ def main():
             c, params, cache, args, "full",
             attention_fn=make_cache_attention_fn())
     results["weights_stream"] = time_weights_stream(c, params, args)
+    del cache                       # free HBM for the fused copies
+    results["fused_stream"] = time_weights_stream_fused(c, params, args)
     results["sort_alone"] = time_sort_alone(args, c.vocab_size)
 
     note("\n--- attribution (ms/step) ---")
@@ -284,7 +354,8 @@ def main():
         for k, v in results.items():
             if k == "full":
                 note(f"full step          : {f:8.3f}")
-            elif k in ("sort_alone", "pallas", "weights_stream"):
+            elif k in ("sort_alone", "pallas", "weights_stream",
+                       "fused_stream"):
                 note(f"{k:19s}: {v:8.3f}")
             else:
                 note(f"delta full-{k:8s}: {f - v:8.3f}")
