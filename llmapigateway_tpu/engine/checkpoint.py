@@ -73,6 +73,16 @@ _LLAMA_MAP: list[tuple[re.Pattern, str, bool]] = [
      "layers.wu.{i}", True),
     (re.compile(r"^model\.layers\.(\d+)\.mlp\.down_proj\.weight$"),
      "layers.wd.{i}", True),
+    # Phi-3 family: HF ships the attention and MLP up-projections FUSED
+    # (qkv_proj [(H+2KV)*Dh, D], gate_up_proj [2F, D]). Mapped to
+    # placeholder keys; load_checkpoint splits them into the stacked
+    # wq/wk/wv and wg/wu params (split happens at SOURCE precision and
+    # BEFORE the preprocess hook, so int8-at-source quantization scales
+    # are per-projection, identical to an unfused checkpoint's).
+    (re.compile(r"^model\.layers\.(\d+)\.self_attn\.qkv_proj\.weight$"),
+     "layers.__qkv__.{i}", False),
+    (re.compile(r"^model\.layers\.(\d+)\.mlp\.gate_up_proj\.weight$"),
+     "layers.__gu__.{i}", False),
     # Mixtral MoE
     (re.compile(r"^model\.layers\.(\d+)\.block_sparse_moe\.gate\.weight$"),
      "layers.router.{i}", True),
@@ -100,6 +110,17 @@ def _map_name(hf_name: str) -> tuple[str, int | None, int | None, bool] | None:
                 key = key[len("layers."):]
             return key, layer, expert, transpose
     return None
+
+
+def _fused_bounds(key: str, c: ModelConfig) -> list[tuple[str, int, int]]:
+    """Row ranges of each projection inside a Phi-3 fused tensor (HF
+    orientation: rows are the output dim)."""
+    if key == "__qkv__":
+        qw = c.n_heads * c.head_dim
+        kvw = c.n_kv_heads * c.head_dim
+        return [("wq", 0, qw), ("wk", qw, qw + kvw),
+                ("wv", qw + kvw, qw + 2 * kvw)]
+    return [("wg", 0, c.d_ff), ("wu", c.d_ff, 2 * c.d_ff)]
 
 
 def load_checkpoint(model_dir: str | Path, config: ModelConfig,
@@ -148,13 +169,17 @@ def load_checkpoint(model_dir: str | Path, config: ModelConfig,
     # largest single stacked parameter, not the whole checkpoint.
     open_shards: dict[Path, Any] = {}
 
-    def read(name: str, path: str) -> np.ndarray | dict:
-        """One tensor at source precision → preprocessed (cast/quantized)."""
-        shard, _, transpose, _, _ = index[name]
+    def read_raw(name: str) -> np.ndarray:
+        """One tensor at source precision, HF orientation."""
+        shard, _, _, _, _ = index[name]
         if shard not in open_shards:
             open_shards[shard] = safe_open(str(shard), framework="numpy")
-        arr = np.asarray(open_shards[shard].get_tensor(name))
-        if transpose:
+        return np.asarray(open_shards[shard].get_tensor(name))
+
+    def read(name: str, path: str) -> np.ndarray | dict:
+        """One tensor at source precision → preprocessed (cast/quantized)."""
+        arr = read_raw(name)
+        if index[name][2]:
             arr = arr.T
         return preprocess(path, arr)
 
@@ -172,6 +197,43 @@ def load_checkpoint(model_dir: str | Path, config: ModelConfig,
     try:
         for key, names in grouped.items():
             entries = [(index[n][3], index[n][4], n) for n in names]
+            if key in ("__qkv__", "__gu__"):
+                # Phi-3 fused tensors: split rows per projection at source
+                # precision, then transpose/preprocess/stack each exactly
+                # like an unfused checkpoint's tensors. Rows are read via
+                # get_slice so each projection's range is read once (no
+                # whole-tensor re-read per sub) — and the fused row count
+                # is validated against the config-derived bounds: numpy
+                # slice-clamping would otherwise turn a geometry mismatch
+                # into silently wrong weights with config-derived shapes
+                # that pass _validate_shapes.
+                by_l = {l: n for l, _, n in entries}
+                n_layers = max(by_l) + 1
+                subs = _fused_bounds(key, config)
+                expect_rows = subs[-1][2]
+
+                def read_rows(name, lo, hi):
+                    shard = index[name][0]
+                    if shard not in open_shards:
+                        open_shards[shard] = safe_open(str(shard),
+                                                       framework="numpy")
+                    sl = open_shards[shard].get_slice(name)
+                    rows = sl.get_shape()[0]
+                    if rows != expect_rows:
+                        raise ValueError(
+                            f"fused tensor {name} has {rows} rows; config "
+                            f"implies {expect_rows} "
+                            f"({[s[0] for s in subs]})")
+                    return np.asarray(sl[lo:hi])
+
+                for sub, lo, hi in subs:
+                    path = f"layers.{sub}"
+                    stacked = stack([
+                        preprocess(path, read_rows(by_l[l], lo, hi).T)
+                        for l in range(n_layers)])
+                    params["layers"][sub] = place(path, stacked)
+                    del stacked
+                continue
             if entries[0][0] is None:                       # layerless tensor
                 params[key] = place(key, read(names[0], key))
                 continue

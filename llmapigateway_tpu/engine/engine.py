@@ -2582,6 +2582,14 @@ def _config_from_checkpoint(model_path: str) -> ModelConfig:
                            **common)
     if mtype == "qwen2":
         return ModelConfig(family="qwen2", attn_bias=True, **common)
+    if mtype == "phi3":
+        # Phi-3 = llama block with FUSED qkv/gate_up checkpoint tensors
+        # (split by the loader — checkpoint.py _fused_bounds) + sliding
+        # window (mini-4k: 2047). 128k "longrope" variants are refused
+        # by _parse_rope_scaling — silently-wrong RoPE is worse.
+        return ModelConfig(family="llama",
+                           sliding_window=cfg.get("sliding_window") or 0,
+                           **common)
     if mtype == "gemma":
         # Gemma always ties embeddings (HF omits the flag in some configs)
         # and carries an explicit head_dim (7B: 16 x 256 != hidden 3072).

@@ -118,6 +118,13 @@ PRESETS: dict[str, ModelConfig] = {
         vocab_size=32000, d_model=4096, n_layers=32, n_heads=32,
         n_kv_heads=8, d_ff=14336, rope_theta=10000.0, max_seq_len=32768,
         sliding_window=4096),
+    # Phi-3-mini-4k (HF: microsoft/Phi-3-mini-4k-instruct): llama block,
+    # MHA, sliding window 2047; the HF checkpoint ships qkv/gate_up
+    # FUSED (engine/checkpoint.py splits them at load).
+    "phi-3-mini": ModelConfig(
+        vocab_size=32064, d_model=3072, n_layers=32, n_heads=32,
+        n_kv_heads=32, d_ff=8192, rope_theta=10000.0, max_seq_len=4096,
+        sliding_window=2047),
     # Tiny sliding-window model for tests (window << max_seq).
     "tiny-mistral-test": ModelConfig(
         vocab_size=512, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,

@@ -193,6 +193,67 @@ def test_qwen2_checkpoint_logit_parity(tmp_path):
                                rtol=2e-3, atol=2e-3)
 
 
+def test_phi3_checkpoint_logit_parity(tmp_path):
+    """Phi-3 family: HF ships qkv_proj and gate_up_proj FUSED — the
+    loader must split them into the stacked wq/wk/wv and wg/wu params
+    (checkpoint.py _fused_bounds) with logits matching HF torch, and
+    the config must pick up the family's sliding window."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from llmapigateway_tpu.engine.engine import _config_from_checkpoint
+
+    hf_cfg = transformers.Phi3Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rms_norm_eps=1e-5, rope_theta=10000.0,
+        tie_word_embeddings=False, sliding_window=4,
+        pad_token_id=0)       # Phi3Config default (32000) exceeds tiny vocab
+    torch.manual_seed(3)
+    model = transformers.Phi3ForCausalLM(hf_cfg)
+    model.eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    cfg = _config_from_checkpoint(tmp_path)
+    # Window (4) narrower than the prompt (8): parity below actually
+    # engages the sliding-window mask, so a one-off in the window
+    # convention vs HF Phi3 cannot pass silently.
+    assert cfg.family == "llama" and cfg.sliding_window == 4
+    assert cfg.n_kv_heads == 2
+
+    params = load_checkpoint(tmp_path, cfg, dtype=jnp.float32)
+    # The fused tensors landed split and stacked: wq [L, D, H*Dh],
+    # wk/wv [L, D, KV*Dh], wg/wu [L, D, F].
+    assert params["layers"]["wq"].shape == (2, 64, 64)
+    assert params["layers"]["wk"].shape == (2, 64, 32)
+    assert params["layers"]["wg"].shape == (2, 64, 128)
+
+    ids = np.array([[5, 17, 99, 3, 42, 7, 81, 2]], dtype=np.int32)
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(ids, dtype=torch.long)).logits.numpy()
+    cache = llama.KVCache.create(cfg, 1, 32, dtype=jnp.float32)
+    logits, cache = llama.forward(params, cfg, jnp.asarray(ids),
+                                  jnp.zeros((1,), jnp.int32), cache)
+    np.testing.assert_allclose(np.asarray(logits), hf_logits,
+                               rtol=2e-3, atol=2e-3)
+    # Decode step (deferred-insert path) matches HF's next position too.
+    ids2 = np.concatenate([ids, [[9]]], axis=1)
+    with torch.no_grad():
+        hf2 = model(torch.tensor(ids2, dtype=torch.long)).logits.numpy()
+    logits2, _ = llama.forward(
+        params, cfg, jnp.asarray([[9]], jnp.int32),
+        jnp.full((1,), 8, jnp.int32), cache,
+        active=jnp.ones((1,), bool))
+    np.testing.assert_allclose(np.asarray(logits2[:, 0]), hf2[:, -1],
+                               rtol=2e-3, atol=2e-3)
+    # Geometry mismatch must REFUSE, not slice-clamp into silently wrong
+    # weights (the split derives shapes from the config, so
+    # _validate_shapes alone could not catch it).
+    import dataclasses
+    with pytest.raises(ValueError, match="fused tensor"):
+        load_checkpoint(tmp_path, dataclasses.replace(cfg, d_ff=96),
+                        dtype=jnp.float32)
+
+
 def test_rope_scaling_unsupported_type_rejected(tmp_path):
     from llmapigateway_tpu.engine.engine import _parse_rope_scaling
     assert _parse_rope_scaling(None) is None
