@@ -831,6 +831,11 @@ def main() -> None:
                     bargs, "contiguous", preset=args.eight_b_preset,
                     batch=b8, quant="int8", kv_quant="int8",
                     ttft_target=args.ttft_target)
+                # Compile every burst-depth rung BEFORE measuring: the
+                # adaptive controller wanders depths, and a mid-probe
+                # 10-20 s XLA compile would be recorded as that probe's
+                # TTFT (AOT from avals; hits the persistent cache).
+                engine._warm_decode_variants()
                 sched_tok_s = scheduler_throughput(engine, bargs)
                 reset_slots(engine)
                 t = measure_ttft_under_load(engine, bargs)
@@ -1107,6 +1112,7 @@ def main() -> None:
             engine = None
             engine, _ = build_engine(args, "contiguous",
                                      ttft_target=args.ttft_target)
+            engine._warm_decode_variants()      # all depth rungs, AOT
             sched_tok_s = scheduler_throughput(engine, args)
             reset_slots(engine)
             t = measure_ttft_under_load(engine, args)
