@@ -186,17 +186,23 @@ def pytest_runtest_protocol(item, nextitem):
             f"\n[parity-rerun] {item.nodeid} failed; retrying in a fresh "
             "process (XLA-CPU compile nondeterminism can flip near-tie "
             "argmax on random weights — see conftest)\n")
-        try:
-            sub = subprocess.run(
-                [sys.executable, "-m", "pytest", item.nodeid, "-q", "-x"],
-                capture_output=True, text=True, timeout=900,
-                cwd=str(item.config.rootpath),
-                env={**os.environ, "_PARITY_RERUN_CHILD": "1"})
-        except subprocess.TimeoutExpired:
-            # A hung retry (the environment this policy exists for) must
-            # record the original failure, not crash the session.
-            sub = subprocess.CompletedProcess(
-                [], returncode=124, stdout="fresh-process retry timed out")
+        sub = None
+        for _attempt in range(2):       # two fresh processes: one can hit
+            try:                        # transient load/contention noise
+                sub = subprocess.run(
+                    [sys.executable, "-m", "pytest", item.nodeid,
+                     "-q", "-x"],
+                    capture_output=True, text=True, timeout=900,
+                    cwd=str(item.config.rootpath),
+                    env={**os.environ, "_PARITY_RERUN_CHILD": "1"})
+            except subprocess.TimeoutExpired:
+                # A hung retry (the environment this policy exists for)
+                # must record the original failure, not crash the session.
+                sub = subprocess.CompletedProcess(
+                    [], returncode=124,
+                    stdout="fresh-process retry timed out")
+            if sub.returncode == 0:
+                break
         if sub.returncode == 0:
             # Fresh-process pass: replace the failed call report with the
             # retry's outcome so the suite records the adjudicated result.
