@@ -120,6 +120,8 @@ class GenRequest:
     temperature: float = 0.0
     top_p: float = 1.0
     top_k: int = 0
+    presence_penalty: float = 0.0     # OpenAI semantics; engine-native
+    frequency_penalty: float = 0.0    # (engine/sampling.py apply_penalties)
     stop: list[str] = field(default_factory=list)
 
     # Filled by the engine:
@@ -519,6 +521,21 @@ class InferenceEngine:
         self.samp_temperature = np.zeros((self.B,), np.float32)
         self.samp_top_p = np.ones((self.B,), np.float32)
         self.samp_top_k = np.zeros((self.B,), np.int32)
+        self.samp_presence = np.zeros((self.B,), np.float32)
+        self.samp_frequency = np.zeros((self.B,), np.float32)
+        # Token-occurrence state for presence/frequency penalties:
+        # [B, V] int32, DEVICE-authoritative (prefill resets a slot's row
+        # and counts the prompt; the general decode path counts each
+        # step's INPUT token — so the count visible when sampling token
+        # t+1 covers prompt + generated through t, and multihost
+        # followers stay bit-identical without broadcasting sampled
+        # tokens). The greedy fast path passes it through untouched:
+        # stale rows are harmless because a row's counts only matter to
+        # its OWN request's penalties, and penalty requests are (a)
+        # reset at admission and (b) force the general path.
+        self._d_counts = jax.device_put(
+            np.zeros((self.B, self.model_cfg.vocab_size), np.int32),
+            NamedSharding(self.mesh, P()))
         # Typed PRNG key end-to-end (the legacy raw-uint32 path is slated to
         # become an error in future JAX); the multihost broadcast bit-casts
         # via key_data/wrap_key_data at the wire boundary only.
@@ -671,13 +688,15 @@ class InferenceEngine:
 
         replicated = NamedSharding(self.mesh, P())
 
-        @partial(jax.jit, donate_argnums=(1,))
-        def prefill_step(params, cache: llama.KVCache, tokens: jax.Array,
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def prefill_step(params, cache: llama.KVCache, counts: jax.Array,
+                         tokens: jax.Array,
                          start_len: jax.Array, slots: jax.Array,
                          last_idx: jax.Array, samp_t: jax.Array,
                          samp_p: jax.Array, samp_k: jax.Array,
+                         samp_pp: jax.Array, samp_fp: jax.Array,
                          key: jax.Array
-                         ) -> tuple[jax.Array, llama.KVCache]:
+                         ) -> tuple[jax.Array, jax.Array, llama.KVCache]:
             """Run one prompt chunk for each of K slots. tokens [K, C],
             start_len/slots/last_idx/samp_* [K]. Returns (first_tokens
             [K, replicated], cache). K=1 is the single-request path;
@@ -715,21 +734,26 @@ class InferenceEngine:
                 return full
             new_k = jax.tree.map(scatter, cache.k, row_cache.k)
             new_v = jax.tree.map(scatter, cache.v, row_cache.v)
+            counts, count_rows = _prefill_counts(
+                counts, tokens, start_len, slots, last_idx)
             rows = jax.lax.with_sharding_constraint(
                 jnp.take_along_axis(
                     logits, last_idx[:, None, None], axis=1)[:, 0, :],
                 replicated)
             samp = SamplingParams(temperature=samp_t, top_p=samp_p,
-                                  top_k=samp_k)
+                                  top_k=samp_k, presence_penalty=samp_pp,
+                                  frequency_penalty=samp_fp)
             first = jax.lax.with_sharding_constraint(
-                sample(rows, samp, key), replicated)
-            return first, llama.KVCache(k=new_k, v=new_v)
+                sample(rows, samp, key, counts=count_rows), replicated)
+            return first, counts, llama.KVCache(k=new_k, v=new_v)
 
-        def one_step(params, cache: llama.KVCache, tokens: jax.Array,
+        def one_step(params, cache: llama.KVCache, counts: jax.Array,
+                     tokens: jax.Array,
                      lengths: jax.Array, active: jax.Array,
                      samp: SamplingParams, key: jax.Array, *,
                      greedy: bool = False
-                     ) -> tuple[jax.Array, jax.Array, llama.KVCache]:
+                     ) -> tuple[jax.Array, jax.Array, jax.Array,
+                                llama.KVCache]:
             """One decode step — the ONE copy of the forward+sample+advance
             body; both compiled programs below are built from it. Returns
             (next_tokens, new_lengths, cache) so the token/length feedback
@@ -740,18 +764,29 @@ class InferenceEngine:
             of a multi-host mesh. ``greedy=True`` compiles the
             argmax-only variant — it skips the full-vocab sort the general
             sampler pays per step; the scheduler picks it whenever every
-            active slot has temperature 0 (the common serving case)."""
+            active slot has temperature 0 AND zero penalties (the common
+            serving case; a penalized argmax differs from plain argmax,
+            so penalty requests ride the general path). The general path
+            counts each step's INPUT token before sampling, so the
+            penalty counts cover prompt + generated through step t when
+            sampling t+1 (engine/sampling.py apply_penalties); the
+            greedy path passes counts through untouched (aliased
+            donation, zero cost)."""
+            if not greedy:
+                counts = counts.at[jnp.arange(counts.shape[0]),
+                                   tokens].add(active.astype(jnp.int32))
             logits, cache = model_forward(
                 params, c, tokens[:, None], lengths, cache, active=active)
             if greedy:
                 next_tokens = jnp.argmax(
                     logits[:, 0, :], axis=-1).astype(jnp.int32)
             else:
-                next_tokens = sample(logits[:, 0, :], samp, key)
+                next_tokens = sample(logits[:, 0, :], samp, key,
+                                     counts=counts)
             next_tokens = jax.lax.with_sharding_constraint(
                 next_tokens, replicated)
             new_lengths = jnp.where(active, lengths + 1, lengths)
-            return next_tokens, new_lengths, cache
+            return next_tokens, new_lengths, counts, cache
 
         self._prefill_fn = prefill_step
         self._decode_fns = _decode_programs(one_step, self._burst_depths)
@@ -861,13 +896,15 @@ class InferenceEngine:
                 return family_forward(params, c, tokens, lengths, cache,
                                       active=active, attention_fn=attn)
 
-        @partial(jax.jit, donate_argnums=(1,))
-        def prefill_step(params, cache: PagedKVCache, table: jax.Array,
+        @partial(jax.jit, donate_argnums=(1, 2))
+        def prefill_step(params, cache: PagedKVCache, counts: jax.Array,
+                         table: jax.Array,
                          tokens: jax.Array, start_len: jax.Array,
                          slots: jax.Array, last_idx: jax.Array,
                          samp_t: jax.Array, samp_p: jax.Array,
-                         samp_k: jax.Array, key: jax.Array
-                         ) -> tuple[jax.Array, PagedKVCache]:
+                         samp_k: jax.Array, samp_pp: jax.Array,
+                         samp_fp: jax.Array, key: jax.Array
+                         ) -> tuple[jax.Array, jax.Array, PagedKVCache]:
             """One prompt chunk for each of K slots (dense twin's batched
             admission — see its docstring). tokens [K, C]; the pool is
             global, so unlike the dense path there is no per-slot cache
@@ -880,24 +917,32 @@ class InferenceEngine:
                  for k in range(K)], axis=0)
             logits, cache = call_forward(params, cache, rows_tbl, tokens,
                                          start_len, prefill=True)
+            counts, count_rows = _prefill_counts(
+                counts, tokens, start_len, slots, last_idx)
             rows = jax.lax.with_sharding_constraint(
                 jnp.take_along_axis(
                     logits, last_idx[:, None, None], axis=1)[:, 0, :],
                 replicated)
             samp = SamplingParams(temperature=samp_t, top_p=samp_p,
-                                  top_k=samp_k)
+                                  top_k=samp_k, presence_penalty=samp_pp,
+                                  frequency_penalty=samp_fp)
             first = jax.lax.with_sharding_constraint(
-                sample(rows, samp, key), replicated)
-            return first, PagedKVCache(k=cache.k, v=cache.v)
+                sample(rows, samp, key, counts=count_rows), replicated)
+            return first, counts, PagedKVCache(k=cache.k, v=cache.v)
 
-        def one_step(params, cache: PagedKVCache, table: jax.Array,
+        def one_step(params, cache: PagedKVCache, counts: jax.Array,
+                     table: jax.Array,
                      tokens: jax.Array, lengths: jax.Array,
                      active: jax.Array, samp: SamplingParams,
                      key: jax.Array, *, greedy: bool = False):
             """Paged one-step twin (page table routes the cache rows). The
             table is loop-invariant under the burst scan — pages are
             reserved for a request's whole lifetime at admission, so no
-            page can change mid-burst."""
+            page can change mid-burst. Penalty counts as the dense twin:
+            general path counts the input token; greedy passes through."""
+            if not greedy:
+                counts = counts.at[jnp.arange(counts.shape[0]),
+                                   tokens].add(active.astype(jnp.int32))
             logits, cache = call_forward(params, cache, table,
                                          tokens[:, None], lengths,
                                          active=active)
@@ -905,11 +950,12 @@ class InferenceEngine:
                 next_tokens = jnp.argmax(
                     logits[:, 0, :], axis=-1).astype(jnp.int32)
             else:
-                next_tokens = sample(logits[:, 0, :], samp, key)
+                next_tokens = sample(logits[:, 0, :], samp, key,
+                                     counts=counts)
             next_tokens = jax.lax.with_sharding_constraint(
                 next_tokens, replicated)
             new_lengths = jnp.where(active, lengths + 1, lengths)
-            return (next_tokens, new_lengths,
+            return (next_tokens, new_lengths, counts,
                     PagedKVCache(k=cache.k, v=cache.v))
 
         self._prefill_fn = prefill_step
@@ -954,10 +1000,13 @@ class InferenceEngine:
                 return jax.ShapeDtypeStruct((self.B,), dt, sharding=rep)
             samp_a = SamplingParams(temperature=vec(jnp.float32),
                                     top_p=vec(jnp.float32),
-                                    top_k=vec(jnp.int32))
+                                    top_k=vec(jnp.int32),
+                                    presence_penalty=vec(jnp.float32),
+                                    frequency_penalty=vec(jnp.float32))
             table_a = (aval(self._device_table()),) if self.paged else ()
             args = (jax.tree.map(aval, self.params),
-                    jax.tree.map(aval, self.cache), *table_a,
+                    jax.tree.map(aval, self.cache),
+                    aval(self._d_counts), *table_a,
                     vec(jnp.int32), vec(jnp.int32), vec(jnp.bool_),
                     samp_a, aval(self._rng))
             for greedy in (False, True):
@@ -1220,8 +1269,7 @@ class InferenceEngine:
             # sampled requests flip the whole batch to the normal burst
             # path for their lifetime — mixed batches stay correct, just
             # unaccelerated.
-            spec_now = self.spec_k and not bool(
-                np.any(self.samp_temperature[self.active] > 0))
+            spec_now = self.spec_k and self._all_greedy()
             # Adaptive drafting gate: drafting only pays while accepted
             # tokens/step clears the verify forward's overhead
             # (config.spec_min_tokens_per_step). Below it, decode normally
@@ -1423,7 +1471,8 @@ class InferenceEngine:
             slots.append(slot)
             poss.append(pos)
             chunks.append(chunk)
-            samps.append((req.temperature, req.top_p, req.top_k))
+            samps.append((req.temperature, req.top_p, req.top_k,
+                          req.presence_penalty, req.frequency_penalty))
         self._rng, key = jax.random.split(self._rng)
         first, self.cache = self._exec_prefill(
             slots, poss, chunks, samp=samps, key=key)
@@ -1456,6 +1505,8 @@ class InferenceEngine:
             self.samp_temperature[req.slot] = req.temperature
             self.samp_top_p[req.slot] = req.top_p
             self.samp_top_k[req.slot] = req.top_k
+            self.samp_presence[req.slot] = req.presence_penalty
+            self.samp_frequency[req.slot] = req.frequency_penalty
             self._d_dirty = True
             done.append(True)
         return done
@@ -1484,7 +1535,7 @@ class InferenceEngine:
         poss = [pos] if single else list(pos)
         chunks = [chunk] if single else list(chunk)
         samps = ([samp] if single else list(samp)) if samp is not None \
-            else [(0.0, 1.0, 0)] * len(slots)
+            else [(0.0, 1.0, 0, 0.0, 0.0)] * len(slots)
         K = len(slots)
         bucket = min(_bucket(max(len(ch) for ch in chunks),
                              self.prefill_chunk),
@@ -1502,19 +1553,24 @@ class InferenceEngine:
         table = (self._device_table(),) if self.paged else ()
         if key is None:
             key = _DUMMY_KEY()
-        return self._prefill_fn(
-            self.params, self.cache, *table, padded,
+        first, self._d_counts, cache = self._prefill_fn(
+            self.params, self.cache, self._d_counts, *table, padded,
             np.asarray(poss, np.int32), np.asarray(slots, np.int32),
             np.asarray([len(ch) - 1 for ch in chunks], np.int32),
             np.asarray([s[0] for s in samps], np.float32),
             np.asarray([s[1] for s in samps], np.float32),
-            np.asarray([s[2] for s in samps], np.int32), key)
+            np.asarray([s[2] for s in samps], np.int32),
+            np.asarray([s[3] for s in samps], np.float32),
+            np.asarray([s[4] for s in samps], np.float32), key)
+        return first, cache
 
     def _exec_decode(self, n_steps: int, state: dict) -> list[np.ndarray]:
         """Run a burst from broadcast-packed host state (multihost path) —
         identical on coordinator and followers."""
         samp = SamplingParams(temperature=state["temperature"],
-                              top_p=state["top_p"], top_k=state["top_k"])
+                              top_p=state["top_p"], top_k=state["top_k"],
+                              presence_penalty=state["presence"],
+                              frequency_penalty=state["frequency"])
         tokens = state["last_token"]
         lengths = state["lengths"]
         active = state["active"]
@@ -1523,15 +1579,17 @@ class InferenceEngine:
         table = (self._device_table(),) if self.paged else ()
         # Greedy fast path: computed from the broadcast state, so every
         # process of a multi-host mesh picks the same program.
-        greedy = not bool(np.any(
-            np.asarray(state["temperature"])[np.asarray(state["active"])]
-            > 0))
+        act = np.asarray(state["active"])
+        greedy = not bool(
+            np.any(np.asarray(state["temperature"])[act] > 0)
+            or np.any(np.asarray(state["presence"])[act] != 0)
+            or np.any(np.asarray(state["frequency"])[act] != 0))
         step_fn, scans = self._decode_fns[greedy]
         scan_fn = scans.get(n_steps)
         if scan_fn is not None:
-            toks, _, _, self.cache = scan_fn(
-                self.params, self.cache, *table, tokens, lengths, active,
-                samp, key)
+            toks, _, _, self._d_counts, self.cache = scan_fn(
+                self.params, self.cache, self._d_counts, *table, tokens,
+                lengths, active, samp, key)
             host = np.asarray(toks)
             return [host[i] for i in range(n_steps)]
         # Feedback stays as device arrays across the chain (outputs are
@@ -1541,9 +1599,9 @@ class InferenceEngine:
         pending = []
         for _ in range(n_steps):
             key, sub = jax.random.split(key)
-            tokens, lengths, self.cache = step_fn(
-                self.params, self.cache, *table, tokens, lengths, active,
-                samp, sub)
+            tokens, lengths, self._d_counts, self.cache = step_fn(
+                self.params, self.cache, self._d_counts, *table, tokens,
+                lengths, active, samp, sub)
             try:
                 tokens.copy_to_host_async()
             except Exception:           # backend without async copies
@@ -1669,6 +1727,7 @@ class InferenceEngine:
             packed = self._bridge.pack_decode_state(
                 self.lengths, self.active, self.last_token,
                 self.samp_top_k, self.samp_temperature, self.samp_top_p,
+                self.samp_presence, self.samp_frequency,
                 np.asarray(jax.random.key_data(key)))
             self._bridge.publish_spec(n_steps, reupload, packed,
                                       table=self._table_to_publish())
@@ -1761,7 +1820,11 @@ class InferenceEngine:
             top_p=jax.device_put(np.asarray(
                 s.get("top_p", self.samp_top_p), np.float32), rep),
             top_k=jax.device_put(np.asarray(
-                s.get("top_k", self.samp_top_k), np.int32), rep))
+                s.get("top_k", self.samp_top_k), np.int32), rep),
+            presence_penalty=jax.device_put(np.asarray(
+                s.get("presence", self.samp_presence), np.float32), rep),
+            frequency_penalty=jax.device_put(np.asarray(
+                s.get("frequency", self.samp_frequency), np.float32), rep))
 
     def _exec_spec(self, n_steps: int, state: dict | None) -> np.ndarray:
         """The one compiled-speculative-burst call — identical on
@@ -1991,6 +2054,15 @@ class InferenceEngine:
         if changed:
             self._table_dirty = True
 
+    def _all_greedy(self) -> bool:
+        """True when every ACTIVE slot is plain-greedy: temperature 0 and
+        zero penalties — the condition for the argmax-only decode program
+        AND for speculation (its verify is plain argmax)."""
+        a = self.active
+        return not bool(np.any(self.samp_temperature[a] > 0)
+                        or np.any(self.samp_presence[a] != 0)
+                        or np.any(self.samp_frequency[a] != 0))
+
     def _burst_depth(self, busy: bool) -> int:
         """Depth of the next normal decode burst.
 
@@ -2063,7 +2135,8 @@ class InferenceEngine:
             self._rng, key = jax.random.split(self._rng)
             packed = self._bridge.pack_decode_state(
                 self.lengths, self.active, self.last_token, self.samp_top_k,
-                self.samp_temperature, self.samp_top_p,
+                self.samp_temperature, self.samp_top_p, self.samp_presence,
+                self.samp_frequency,
                 np.asarray(jax.random.key_data(key)))
             self._bridge.publish_decode(n_steps, packed,
                                         table=self._table_to_publish())
@@ -2097,14 +2170,18 @@ class InferenceEngine:
             self._d_samp = SamplingParams(
                 temperature=jax.device_put(self.samp_temperature, rep),
                 top_p=jax.device_put(self.samp_top_p, rep),
-                top_k=jax.device_put(self.samp_top_k, rep))
+                top_k=jax.device_put(self.samp_top_k, rep),
+                presence_penalty=jax.device_put(self.samp_presence, rep),
+                frequency_penalty=jax.device_put(self.samp_frequency, rep))
             self._d_dirty = False
 
         table = (self._device_table(),) if self.paged else ()
         # Greedy fast path: when every active slot decodes at temperature 0
-        # (the common case), run the argmax-only program — the general
-        # sampler's full-vocab sort costs measurable per-step time.
-        greedy = not bool(np.any(self.samp_temperature[self.active] > 0))
+        # with zero penalties (the common case), run the argmax-only
+        # program — the general sampler's full-vocab sort costs
+        # measurable per-step time (penalties force the general path:
+        # a penalized argmax differs from plain argmax).
+        greedy = self._all_greedy()
         step_fn, scans = self._decode_fns[greedy]
         scan_fn = scans.get(n_steps)
         if scan_fn is not None:
@@ -2117,10 +2194,11 @@ class InferenceEngine:
             # pending) fall through to the synchronous step loop below.
             t0 = time.monotonic()
             self._rng, key = jax.random.split(self._rng)
-            toks, self._d_tokens, self._d_lengths, self.cache = \
-                scan_fn(
-                    self.params, self.cache, *table, self._d_tokens,
-                    self._d_lengths, self._d_active, self._d_samp, key)
+            toks, self._d_tokens, self._d_lengths, self._d_counts, \
+                self.cache = scan_fn(
+                    self.params, self.cache, self._d_counts, *table,
+                    self._d_tokens, self._d_lengths, self._d_active,
+                    self._d_samp, key)
             try:
                 toks.copy_to_host_async()
             except Exception:           # backend without async copies
@@ -2160,9 +2238,11 @@ class InferenceEngine:
         pending: list[jax.Array] = []
         for _ in range(n_steps):
             self._rng, key = jax.random.split(self._rng)
-            self._d_tokens, self._d_lengths, self.cache = step_fn(
-                self.params, self.cache, *table, self._d_tokens,
-                self._d_lengths, self._d_active, self._d_samp, key)
+            self._d_tokens, self._d_lengths, self._d_counts, self.cache = \
+                step_fn(
+                    self.params, self.cache, self._d_counts, *table,
+                    self._d_tokens, self._d_lengths, self._d_active,
+                    self._d_samp, key)
             try:
                 self._d_tokens.copy_to_host_async()
             except Exception:           # backend without async copies
@@ -2428,6 +2508,26 @@ def _seq_prefill_attention_fn(mesh, kind: str = "ring"):
     return attention_fn
 
 
+def _prefill_counts(counts, tokens, start_len, slots, last_idx):
+    """Penalty-count maintenance for a prefill chunk group: reset each
+    slot's row at prompt start (start_len == 0), add the chunk's REAL
+    tokens (bucket pads masked via last_idx), and return (updated
+    counts [B, V], the K updated rows [K, V] — the penalty source for
+    this program's folded first-token sampling). Multihost-safe: every
+    input is broadcast state, so follower counts stay bit-identical."""
+    K, C = tokens.shape
+    pos_ok = (jnp.arange(C)[None, :] <= last_idx[:, None]).astype(jnp.int32)
+    rows = []
+    for k in range(K):
+        row = jax.lax.dynamic_slice_in_dim(counts, slots[k], 1, axis=0)[0]
+        row = jnp.where(start_len[k] == 0, jnp.zeros_like(row), row)
+        row = row.at[tokens[k]].add(pos_ok[k])
+        counts = jax.lax.dynamic_update_slice_in_dim(
+            counts, row[None], slots[k], axis=0)
+        rows.append(row)
+    return counts, jnp.stack(rows)
+
+
 def _decode_programs(one_step, burst_lens: tuple[int, ...]):
     """Build the decode programs from one step body: the per-step program,
     and a fused lax.scan per distinct burst length in ``burst_lens`` — ONE
@@ -2436,9 +2536,10 @@ def _decode_programs(one_step, burst_lens: tuple[int, ...]):
     FLOPs. Two lengths are compiled in practice: the deep throughput burst
     and the shallow "busy" burst used while prefill work is interleaving
     (so busy-mode decode stays pipelined instead of dropping to
-    synchronous single steps). `one_step(params, cache, [table,] tokens,
-    lengths, active, samp, key, greedy=) -> (next_tokens, new_lengths,
-    cache)`.
+    synchronous single steps). `one_step(params, cache, counts, [table,]
+    tokens, lengths, active, samp, key, greedy=) -> (next_tokens,
+    new_lengths, counts, cache)`; the penalty-count state rides the
+    scan carry beside the cache (donated like it).
 
     Returns ``{greedy: (step, {n: scan})}`` for greedy in (False, True);
     the scheduler picks per burst (jit compiles lazily, so an engine that
@@ -2447,23 +2548,24 @@ def _decode_programs(one_step, burst_lens: tuple[int, ...]):
 
     def build(greedy: bool):
         step = partial(one_step, greedy=greedy)
-        decode_step = partial(jax.jit, donate_argnums=(1,))(step)
+        decode_step = partial(jax.jit, donate_argnums=(1, 2))(step)
 
         def make_scan(n_burst: int):
-            @partial(jax.jit, donate_argnums=(1,))
-            def decode_scan(params, cache, *rest):
+            @partial(jax.jit, donate_argnums=(1, 2))
+            def decode_scan(params, cache, counts, *rest):
                 *table, tokens, lengths, active, samp, key = rest
 
                 def body(carry, _):
-                    cache, tokens, lengths, key = carry
+                    cache, counts, tokens, lengths, key = carry
                     key, sub = jax.random.split(key)
-                    nt, nl, cache = step(params, cache, *table, tokens,
-                                         lengths, active, samp, sub)
-                    return (cache, nt, nl, key), nt
-                (cache, tokens, lengths, key), toks = jax.lax.scan(
-                    body, (cache, tokens, lengths, key), None,
+                    nt, nl, counts, cache = step(
+                        params, cache, counts, *table, tokens,
+                        lengths, active, samp, sub)
+                    return (cache, counts, nt, nl, key), nt
+                (cache, counts, tokens, lengths, key), toks = jax.lax.scan(
+                    body, (cache, counts, tokens, lengths, key), None,
                     length=n_burst)
-                return toks, tokens, lengths, cache
+                return toks, tokens, lengths, counts, cache
             return decode_scan
 
         return decode_step, {n: make_scan(n) for n in lens}

@@ -19,18 +19,41 @@ class SamplingParams(NamedTuple):
     temperature: jax.Array    # [B] fp32; 0 → greedy
     top_p: jax.Array          # [B] fp32 in (0, 1]; 1 → disabled
     top_k: jax.Array          # [B] int32; 0 → disabled
+    presence_penalty: jax.Array   # [B] fp32; 0 → disabled
+    frequency_penalty: jax.Array  # [B] fp32; 0 → disabled
 
     @classmethod
     def create(cls, batch: int) -> "SamplingParams":
         return cls(temperature=jnp.zeros((batch,), jnp.float32),
                    top_p=jnp.ones((batch,), jnp.float32),
-                   top_k=jnp.zeros((batch,), jnp.int32))
+                   top_k=jnp.zeros((batch,), jnp.int32),
+                   presence_penalty=jnp.zeros((batch,), jnp.float32),
+                   frequency_penalty=jnp.zeros((batch,), jnp.float32))
 
 
-def sample(logits: jax.Array, params: SamplingParams,
-           key: jax.Array) -> jax.Array:
-    """Sample next tokens. logits [B, V] fp32 → tokens [B] int32."""
+def apply_penalties(logits: jax.Array, counts: jax.Array | None,
+                    params: SamplingParams) -> jax.Array:
+    """OpenAI-style presence/frequency penalties over the text so far:
+    ``logits - frequency_penalty·count(token) - presence_penalty·
+    [count(token] > 0)``, per slot. ``counts [B, V] int32`` is the
+    engine-maintained token-occurrence state (prompt + generated);
+    None → no penalty source (greedy fast path, spec verify)."""
+    if counts is None:
+        return logits
+    pen = (params.frequency_penalty[:, None] * counts.astype(jnp.float32)
+           + params.presence_penalty[:, None]
+           * (counts > 0).astype(jnp.float32))
+    return logits - pen
+
+
+def sample(logits: jax.Array, params: SamplingParams, key: jax.Array,
+           counts: jax.Array | None = None) -> jax.Array:
+    """Sample next tokens. logits [B, V] fp32 → tokens [B] int32.
+    Penalties (if ``counts`` given) shift logits BEFORE the greedy
+    argmax, so temperature-0 requests get the penalized argmax —
+    OpenAI applies penalties independently of temperature."""
     B, V = logits.shape
+    logits = apply_penalties(logits, counts, params)
 
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 

@@ -25,9 +25,12 @@ Wire format: ONE int32 vector per command, shape ``[HEADER + payload]``
     concatenates parts, in order, onto the final PREFILL_CHUNK frame
   * DECODE_BURST:   opcode 2, a=n_steps, payload = packed slot state —
     lengths[B], active[B], last_token[B], top_k[B] (int32) then
-    temperature[B], top_p[B] (float32 bit-cast) then rng key (uint32
+    temperature[B], top_p[B], presence_penalty[B],
+    frequency_penalty[B] (float32 bit-cast) then rng key (uint32
     bit-cast) — everything a follower needs to build bit-identical
-    decode inputs.
+    decode inputs. (Penalty COUNTS are never on the wire: both sides'
+    device counts evolve through the same broadcast-input programs,
+    so they stay bit-identical by construction.)
   * SPEC_BURST:     opcode 4, a=n_steps, b=reupload flag, payload = the
     same packed state. The token HISTORY is never on the wire: every
     process maintains a bit-identical host hist mirror (prefill chunks
@@ -130,10 +133,10 @@ class HostBridge:
         self.table_slots = table_slots
         # Payload must fit the larger of: one prefill token segment (capped
         # — longer chunks ship as multiple frames), or the packed decode
-        # state (4 int + 2 float vectors of B, + 2 key), plus the page
+        # state (4 int + 4 float vectors of B, + 2 key), plus the page
         # table tail.
         self.token_capacity = max(min(prefill_bucket_max, TOKEN_FRAME_CAP),
-                                  6 * batch_size + 2)
+                                  8 * batch_size + 2)
         self.payload = self.token_capacity + self.table_size
         self.width = HEADER + self.payload
         if self.enabled:
@@ -199,16 +202,19 @@ class HostBridge:
                                     table=table))
 
     def pack_decode_state(self, lengths, active, last_token, top_k,
-                          temperature, top_p, key) -> np.ndarray:
+                          temperature, top_p, presence, frequency,
+                          key) -> np.ndarray:
         B = self.B
-        out = np.empty((6 * B + 2,), np.int32)
+        out = np.empty((8 * B + 2,), np.int32)
         out[0 * B:1 * B] = lengths
         out[1 * B:2 * B] = np.asarray(active, np.int32)
         out[2 * B:3 * B] = last_token
         out[3 * B:4 * B] = top_k
         out[4 * B:5 * B] = np.asarray(temperature, np.float32).view(np.int32)
         out[5 * B:6 * B] = np.asarray(top_p, np.float32).view(np.int32)
-        out[6 * B:] = np.asarray(key, np.uint32).view(np.int32)
+        out[6 * B:7 * B] = np.asarray(presence, np.float32).view(np.int32)
+        out[7 * B:8 * B] = np.asarray(frequency, np.float32).view(np.int32)
+        out[8 * B:] = np.asarray(key, np.uint32).view(np.int32)
         return out
 
     def unpack_decode_state(self, payload: np.ndarray):
@@ -220,7 +226,9 @@ class HostBridge:
             top_k=payload[3 * B:4 * B].copy(),
             temperature=payload[4 * B:5 * B].view(np.float32).copy(),
             top_p=payload[5 * B:6 * B].view(np.float32).copy(),
-            key=payload[6 * B:6 * B + 2].view(np.uint32).copy(),
+            presence=payload[6 * B:7 * B].view(np.float32).copy(),
+            frequency=payload[7 * B:8 * B].view(np.float32).copy(),
+            key=payload[8 * B:8 * B + 2].view(np.uint32).copy(),
         )
 
     def publish_decode(self, n_steps: int, state: np.ndarray,
