@@ -1,0 +1,24 @@
+"""graftlint — AST-based invariant checker for the gateway.
+
+Project-specific static analysis the stock toolchain can't express:
+async-hygiene on the serving path, JAX tracer safety in compiled-program
+bodies, lock discipline across the engine/router/db layers, secret
+hygiene at log sites, and SSE framing at yield sites. Run it as::
+
+    python -m llmapigateway_tpu.analysis llmapigateway_tpu/
+
+Exit code 0 = clean; 1 = findings; 2 = usage error. tests/test_graftlint.py
+keeps the live tree at exit 0 forever (tier-1 gate). Suppression syntax
+and the rule catalog are documented in tools/README.md.
+"""
+from __future__ import annotations
+
+from .core import (Finding, Rule, analyze_file, analyze_paths,
+                   analyze_source, iter_python_files, package_relpath)
+from .rules import ALL_RULES, RULES_BY_NAME
+
+__all__ = [
+    "ALL_RULES", "RULES_BY_NAME", "Finding", "Rule", "analyze_file",
+    "analyze_paths", "analyze_source", "iter_python_files",
+    "package_relpath",
+]

@@ -1,0 +1,61 @@
+"""CLI entry point: ``python -m llmapigateway_tpu.analysis [paths...]``."""
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+from .core import analyze_file, iter_python_files
+from .reporter import render_json, render_rules, render_text
+from .rules import ALL_RULES, RULES_BY_NAME
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m llmapigateway_tpu.analysis",
+        description="graftlint: AST-based invariant checker for the gateway")
+    parser.add_argument("paths", nargs="*",
+                        help="files/directories to check (default: the "
+                             "installed llmapigateway_tpu package)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--rules", default="",
+                        help="comma-separated subset of rules to run")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        print(render_rules(ALL_RULES))
+        return 0
+
+    rules = list(ALL_RULES)
+    if args.rules:
+        try:
+            rules = [RULES_BY_NAME[n.strip()]
+                     for n in args.rules.split(",") if n.strip()]
+        except KeyError as e:
+            print(f"unknown rule {e.args[0]!r}; available: "
+                  f"{', '.join(sorted(RULES_BY_NAME))}", file=sys.stderr)
+            return 2
+
+    paths = args.paths or [str(Path(__file__).resolve().parents[1])]
+    findings = []
+    n_files = 0
+    for p in paths:
+        root = Path(p)
+        if not root.exists():
+            print(f"no such path: {p}", file=sys.stderr)
+            return 2
+        base = root if root.is_dir() else root.parent
+        for f in iter_python_files(root):
+            n_files += 1
+            findings.extend(analyze_file(f, rules, base))
+    findings.sort(key=lambda x: (x.path, x.line, x.col, x.rule))
+
+    render = render_json if args.format == "json" else render_text
+    print(render(findings, checked_files=n_files))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,190 @@
+"""graftlint core: findings, the rule protocol, suppressions, the driver.
+
+The gateway's hot paths depend on invariants no off-the-shelf tool checks
+(PAPER.md §7: the reference ships zero correctness tooling): the asyncio
+request path must never block the event loop, jitted prefill/decode
+programs must never smuggle host syncs into traced bodies, and the
+engine/router/db layers each carry their own lock discipline. graftlint is
+an AST-level checker for exactly those project invariants — a tier-1 gate
+(tests/test_graftlint.py asserts the live tree is clean), not a style
+linter.
+
+Suppression syntax (documented in tools/README.md):
+
+* trailing comment — suppresses the named rule(s) on that line only::
+
+      time.sleep(0.1)  # graftlint: disable=async-blocking — startup only
+
+* standalone comment line — suppresses the rule(s) for the whole file::
+
+      # graftlint: disable=tracer-hazard
+
+``disable=all`` suppresses every rule. Unknown rule names in a
+suppression are findings themselves (rule ``graftlint-meta``), so stale
+suppressions can't silently rot.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Iterable, Iterator
+
+PACKAGE_NAME = "llmapigateway_tpu"
+
+_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([\w\-,\s]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One rule violation at a source location."""
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message}
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+
+class Rule:
+    """Base class for graftlint rules.
+
+    Subclasses set ``name``/``description`` and either ``dirs`` (package-
+    relative directory prefixes) or ``files`` (exact package-relative
+    paths) to scope where the rule applies; both empty means everywhere.
+    ``check`` receives the parsed module, the raw source, and the
+    package-relative path, and returns findings (unsuppressed — the
+    driver filters)."""
+
+    name: str = ""
+    description: str = ""
+    dirs: tuple[str, ...] = ()
+    files: tuple[str, ...] = ()
+
+    def applies_to(self, relpath: str) -> bool:
+        if not self.dirs and not self.files:
+            return True
+        if relpath in self.files:
+            return True
+        return any(relpath.startswith(d.rstrip("/") + "/") for d in self.dirs)
+
+    def check(self, tree: ast.Module, source: str,
+              relpath: str) -> list[Finding]:
+        raise NotImplementedError
+
+    def finding(self, relpath: str, node: ast.AST, message: str) -> Finding:
+        return Finding(rule=self.name, path=relpath,
+                       line=getattr(node, "lineno", 0),
+                       col=getattr(node, "col_offset", 0), message=message)
+
+
+@dataclass
+class Suppressions:
+    """Parsed ``# graftlint: disable=...`` comments for one file."""
+    file_rules: set[str] = field(default_factory=set)
+    line_rules: dict[int, set[str]] = field(default_factory=dict)
+    bad_names: list[tuple[int, str]] = field(default_factory=list)
+
+    @classmethod
+    def parse(cls, source: str, known_rules: set[str]) -> "Suppressions":
+        supp = cls()
+        for lineno, line in enumerate(source.splitlines(), start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            names = {n.strip() for n in m.group(1).split(",") if n.strip()}
+            for n in names:
+                if n != "all" and n not in known_rules:
+                    supp.bad_names.append((lineno, n))
+            if line.lstrip().startswith("#"):       # standalone → whole file
+                supp.file_rules |= names
+            else:                                   # trailing → this line
+                supp.line_rules.setdefault(lineno, set()).update(names)
+        return supp
+
+    def is_suppressed(self, f: Finding) -> bool:
+        if "all" in self.file_rules or f.rule in self.file_rules:
+            return True
+        on_line = self.line_rules.get(f.line, ())
+        return "all" in on_line or f.rule in on_line
+
+
+def package_relpath(path: str | Path, base: Path | None = None) -> str:
+    """Path relative to the package root: everything after the last
+    ``llmapigateway_tpu`` component (so rule scoping works from any CWD).
+    Paths without the component fall back to ``base``-relative (the CLI
+    passes the scanned root, so out-of-tree layouts still scope), else
+    pass through — fixture paths in tests."""
+    parts = Path(path).parts
+    for i in range(len(parts) - 1, -1, -1):
+        if parts[i] == PACKAGE_NAME:
+            return "/".join(parts[i + 1:])
+    if base is not None:
+        try:
+            return Path(path).resolve().relative_to(
+                base.resolve()).as_posix()
+        except ValueError:
+            pass
+    return "/".join(parts)
+
+
+def analyze_source(source: str, path: str | Path,
+                   rules: Iterable[Rule],
+                   base: Path | None = None) -> list[Finding]:
+    """Run the given rules over one file's source; returns unsuppressed
+    findings sorted by location. A syntax error is itself a finding
+    (rule ``parse-error``) so broken files can't slip past the gate."""
+    relpath = package_relpath(path, base)
+    rules = list(rules)
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError as e:
+        return [Finding(rule="parse-error", path=relpath,
+                        line=e.lineno or 0, col=e.offset or 0,
+                        message=f"syntax error: {e.msg}")]
+    known = {r.name for r in rules}
+    supp = Suppressions.parse(source, known)
+    findings: list[Finding] = []
+    for rule in rules:
+        if rule.applies_to(relpath):
+            findings.extend(rule.check(tree, source, relpath))
+    findings = [f for f in findings if not supp.is_suppressed(f)]
+    for lineno, bad in supp.bad_names:
+        findings.append(Finding(
+            rule="graftlint-meta", path=relpath, line=lineno, col=0,
+            message=f"suppression names unknown rule {bad!r}"))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def analyze_file(path: str | Path, rules: Iterable[Rule],
+                 base: Path | None = None) -> list[Finding]:
+    return analyze_source(Path(path).read_text(), path, rules, base)
+
+
+def iter_python_files(root: str | Path) -> Iterator[Path]:
+    root = Path(root)
+    if root.is_file():
+        yield root
+        return
+    for p in sorted(root.rglob("*.py")):
+        if "__pycache__" not in p.parts:
+            yield p
+
+
+def analyze_paths(paths: Iterable[str | Path],
+                  rules: Iterable[Rule]) -> list[Finding]:
+    rules = list(rules)
+    findings: list[Finding] = []
+    for root in paths:
+        base = Path(root) if Path(root).is_dir() else Path(root).parent
+        for f in iter_python_files(root):
+            findings.extend(analyze_file(f, rules, base))
+    return findings

@@ -1,0 +1,36 @@
+"""graftlint reporters: human text and machine JSON."""
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Iterable
+
+from .core import Finding
+
+
+def render_text(findings: list[Finding], *, checked_files: int) -> str:
+    lines = [f.render() for f in findings]
+    by_rule = Counter(f.rule for f in findings)
+    if findings:
+        summary = ", ".join(f"{rule}: {n}" for rule, n in sorted(by_rule.items()))
+        lines.append(f"graftlint: {len(findings)} finding(s) in "
+                     f"{checked_files} file(s) ({summary})")
+    else:
+        lines.append(f"graftlint: clean ({checked_files} file(s) checked)")
+    return "\n".join(lines)
+
+
+def render_json(findings: list[Finding], *, checked_files: int) -> str:
+    return json.dumps({
+        "findings": [f.to_dict() for f in findings],
+        "count": len(findings),
+        "checked_files": checked_files,
+    }, indent=2)
+
+
+def render_rules(rules: Iterable) -> str:
+    out = []
+    for r in rules:
+        scope = (", ".join(r.dirs + r.files) or "whole package")
+        out.append(f"{r.name}  [{scope}]\n    {r.description}")
+    return "\n".join(out)

@@ -1,0 +1,28 @@
+"""graftlint rule registry.
+
+Each rule module exposes a ``RULE`` singleton; the registry is the
+ordered list the driver and CLI iterate. Adding a rule = adding a module
+here — the fixture tests in tests/test_graftlint.py enforce that every
+registered rule both fires on its known-bad snippet and stays silent on
+its known-good one.
+"""
+from __future__ import annotations
+
+from ..core import Rule
+from .async_blocking import RULE as ASYNC_BLOCKING
+from .lock_discipline import RULE as LOCK_DISCIPLINE
+from .secret_hygiene import RULE as SECRET_HYGIENE
+from .sse_protocol import RULE as SSE_PROTOCOL
+from .tracer_hazard import RULE as TRACER_HAZARD
+
+ALL_RULES: tuple[Rule, ...] = (
+    ASYNC_BLOCKING,
+    TRACER_HAZARD,
+    LOCK_DISCIPLINE,
+    SECRET_HYGIENE,
+    SSE_PROTOCOL,
+)
+
+RULES_BY_NAME: dict[str, Rule] = {r.name: r for r in ALL_RULES}
+
+__all__ = ["ALL_RULES", "RULES_BY_NAME"]

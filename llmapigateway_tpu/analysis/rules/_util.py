@@ -1,0 +1,60 @@
+"""Shared AST helpers for graftlint rules."""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """``a.b.c`` for a Name/Attribute chain, else None (calls, subscripts
+    and other dynamic roots don't resolve — rules treat that as unknown)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> str | None:
+    return dotted_name(node.func)
+
+
+def references_module(node: ast.AST, roots: frozenset[str]) -> bool:
+    """True if any Name in the expression is one of ``roots`` (e.g. a
+    ``jnp.``/``jax.`` usage inside a condition)."""
+    return any(isinstance(n, ast.Name) and n.id in roots
+               for n in ast.walk(node))
+
+
+def contains_call_rooted_at(node: ast.AST, roots: frozenset[str]) -> bool:
+    """True if the expression contains a Call whose function resolves to a
+    dotted name rooted at one of ``roots`` (``jnp.any(x)``,
+    ``jax.lax.cond(...)``)."""
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            name = call_name(n)
+            if name and name.split(".")[0] in roots:
+                return True
+    return False
+
+
+def walk_excluding(node: ast.AST, exclude: tuple[type, ...]) -> Iterator[ast.AST]:
+    """Walk ``node``'s subtree, not descending into children whose type is
+    in ``exclude`` (the node itself is always yielded first)."""
+    yield node
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, exclude):
+            continue
+        yield from walk_excluding(child, exclude)
+
+
+def self_attr(node: ast.AST) -> str | None:
+    """``X`` for an ``self.X`` attribute node, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None

@@ -1,0 +1,128 @@
+"""async-blocking: event-loop-blocking calls lexically inside ``async def``.
+
+The gateway serves every request on one asyncio event loop; a single
+blocking call in the request path stalls *all* in-flight SSE streams (the
+whole reason the engine offloads compiled-program calls to a worker
+thread). This rule flags the blocking primitives this codebase has
+actually reached for — ``time.sleep``, synchronous sqlite3/file I/O,
+``requests.*``, ``jax.block_until_ready``/``jax.device_get``, and
+device-sync fetches (``.item()``, ``float(jnp...)``) — anywhere lexically
+inside an ``async def`` in the serving layers (``server/``, ``routing/``,
+``providers/``).
+
+Bodies of *nested synchronous* functions are skipped: a sync def inside a
+coroutine is how this codebase packages work for ``asyncio.to_thread`` /
+daemon threads, where blocking is the point.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Rule
+from ._util import call_name, references_module
+
+_JAX_ROOTS = frozenset({"jax", "jnp"})
+
+# Exact dotted calls that block the loop.
+_BLOCKING_CALLS = {
+    "time.sleep": "time.sleep() blocks the event loop; use await asyncio.sleep()",
+    "jax.block_until_ready":
+        "jax.block_until_ready() is a host sync; offload via asyncio.to_thread",
+    "jax.device_get":
+        "jax.device_get() is a device->host sync; offload via asyncio.to_thread",
+}
+
+# Any call into these modules is synchronous I/O.
+_BLOCKING_MODULE_ROOTS = {
+    "requests": "requests.* is synchronous HTTP; use the pooled httpx.AsyncClient",
+    "sqlite3": "synchronous sqlite3 call on the event loop; go through the "
+               "DB layer's *_async methods (asyncio.to_thread)",
+}
+
+# Method names that mean synchronous file I/O whatever the receiver
+# (pathlib.Path and file objects both).
+_BLOCKING_METHODS = {
+    "read_text": "synchronous file read on the event loop; use asyncio.to_thread",
+    "write_text": "synchronous file write on the event loop; use asyncio.to_thread",
+    "read_bytes": "synchronous file read on the event loop; use asyncio.to_thread",
+    "write_bytes": "synchronous file write on the event loop; use asyncio.to_thread",
+}
+
+
+class AsyncBlockingRule(Rule):
+    name = "async-blocking"
+    description = ("blocking calls (time.sleep, sync sqlite3/file I/O, "
+                   "requests.*, JAX host syncs, .item()/float(arr)) inside "
+                   "async def bodies in the serving layers")
+    dirs = ("server", "routing", "providers")
+
+    def check(self, tree: ast.Module, source: str,
+              relpath: str) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.AsyncFunctionDef):
+                self._check_async_body(node, relpath, findings)
+        return findings
+
+    def _check_async_body(self, fn: ast.AsyncFunctionDef, relpath: str,
+                          findings: list[Finding]) -> None:
+        # Walk the coroutine body without descending into nested SYNC defs
+        # (worker-thread payloads); nested async defs are still on the loop.
+        stack: list[ast.AST] = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.FunctionDef):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+            if isinstance(node, ast.Call):
+                self._check_call(node, relpath, findings)
+
+    def _check_call(self, node: ast.Call, relpath: str,
+                    findings: list[Finding]) -> None:
+        name = call_name(node)
+        if name is not None:
+            if name in _BLOCKING_CALLS:
+                findings.append(self.finding(
+                    relpath, node, _BLOCKING_CALLS[name]))
+                return
+            root = name.split(".")[0]
+            if root in _BLOCKING_MODULE_ROOTS and "." in name:
+                findings.append(self.finding(
+                    relpath, node, _BLOCKING_MODULE_ROOTS[root]))
+                return
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in _BLOCKING_METHODS:
+                findings.append(self.finding(
+                    relpath, node, _BLOCKING_METHODS[func.attr]))
+                return
+            if func.attr == "item" and not node.args and not node.keywords:
+                findings.append(self.finding(
+                    relpath, node,
+                    ".item() forces a device->host sync on the event loop; "
+                    "fetch via asyncio.to_thread"))
+                return
+        if (isinstance(func, ast.Name) and func.id == "open"
+                and not _is_async_open(node)):
+            findings.append(self.finding(
+                relpath, node,
+                "open() is synchronous file I/O on the event loop; use "
+                "asyncio.to_thread"))
+            return
+        if (isinstance(func, ast.Name) and func.id in ("float", "int")
+                and node.args
+                and references_module(node.args[0], _JAX_ROOTS)):
+            findings.append(self.finding(
+                relpath, node,
+                f"{func.id}() of a JAX array is a device->host sync on the "
+                "event loop; fetch via asyncio.to_thread"))
+
+
+def _is_async_open(node: ast.Call) -> bool:
+    # `async with open(...)` never parses this way, but `aiofiles.open`
+    # resolves as a dotted call, not bare `open` — nothing to special-case
+    # today; kept as a seam for an async-file library if one arrives.
+    return False
+
+
+RULE = AsyncBlockingRule()

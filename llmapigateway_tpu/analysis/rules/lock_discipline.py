@@ -1,0 +1,326 @@
+"""lock-discipline: awaits under threading locks, and guarded-state checks.
+
+Three checks, one rule:
+
+1. **await under a threading lock** — ``await`` inside ``with
+   self._lock:`` where ``_lock`` is a ``threading.Lock``/``RLock`` parks
+   the *whole event loop* on a lock no coroutine can release; only
+   ``asyncio.Lock`` may be held across awaits.
+
+2. **guarded attribute mutated outside its lock** — attributes documented
+   with a trailing ``# guarded-by: <lockname>`` comment on their
+   initialization line must only be mutated (assignment, ``del``,
+   subscript store, or a mutating method call — ``.append``/``.pop``/
+   ``.update``/``.execute``/…) inside a ``with self.<lockname>`` /
+   ``async with self.<lockname>`` block. ``__init__`` is exempt (the
+   object hasn't escaped). Module-level globals guarded by module-level
+   locks are checked the same way.
+
+3. **loop-guarded attribute mutated on a worker thread** — ``# guarded-by:
+   loop`` marks attributes that are event-loop-thread-only (asyncio
+   queues/dicts are not thread-safe). The rule computes the set of
+   methods reachable from ``asyncio.to_thread(self.X, ...)`` /
+   ``threading.Thread(target=self.X)`` dispatch sites via the class's
+   self-call graph and flags mutations of loop-guarded attributes there
+   (engine.py's "worker-thread calls only touch device programs and host
+   numpy state" invariant, made checkable).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from ..core import Finding, Rule
+from ._util import call_name, self_attr
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([\w\-]+)")
+
+_MUTATORS = frozenset({
+    "append", "extend", "insert", "add", "update", "setdefault", "pop",
+    "popitem", "remove", "discard", "clear", "sort", "reverse",
+    "put_nowait", "get_nowait", "set", "execute", "executemany",
+    "executescript", "commit", "rollback", "close", "write",
+})
+
+_THREADING_LOCK_CTORS = {"threading.Lock", "threading.RLock",
+                         "threading.Condition", "threading.Semaphore"}
+_ASYNC_LOCK_CTORS = {"asyncio.Lock", "asyncio.Condition", "asyncio.Semaphore"}
+
+
+@dataclass
+class _ClassInfo:
+    node: ast.ClassDef
+    threading_locks: set[str] = field(default_factory=set)
+    async_locks: set[str] = field(default_factory=set)
+    guards: dict[str, str] = field(default_factory=dict)   # attr -> lock
+    worker_entries: set[str] = field(default_factory=set)
+    self_calls: dict[str, set[str]] = field(default_factory=dict)
+
+
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    description = ("await while holding a threading.Lock; mutation of "
+                   "`# guarded-by: <lock>` attributes outside their lock; "
+                   "mutation of `# guarded-by: loop` attributes in "
+                   "worker-thread-reachable methods")
+
+    def check(self, tree: ast.Module, source: str,
+              relpath: str) -> list[Finding]:
+        lines = source.splitlines()
+        findings: list[Finding] = []
+        mod_locks, mod_guards = self._module_level(tree, lines)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                info = self._scan_class(node, lines)
+                self._check_class(info, mod_locks, relpath, findings)
+        self._check_module_guards(tree, mod_locks, mod_guards, relpath,
+                                  findings)
+        # Check 1 also applies outside classes (module-level locks used in
+        # free async functions).
+        self._check_awaits_under_lock(tree, mod_locks, set(), relpath,
+                                      findings)
+        return findings
+
+    # -- collection ----------------------------------------------------------
+    @staticmethod
+    def _guard_comment(lines: list[str], node: ast.AST) -> str | None:
+        for ln in range(node.lineno, getattr(node, "end_lineno",
+                                             node.lineno) + 1):
+            if ln <= len(lines):
+                m = _GUARDED_RE.search(lines[ln - 1])
+                if m:
+                    return m.group(1)
+        return None
+
+    def _module_level(self, tree: ast.Module,
+                      lines: list[str]) -> tuple[set[str], dict[str, str]]:
+        locks: set[str] = set()
+        guards: dict[str, str] = {}
+        for stmt in tree.body:
+            targets: list[ast.AST] = []
+            value = None
+            if isinstance(stmt, ast.Assign):
+                targets, value = stmt.targets, stmt.value
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                targets, value = [stmt.target], stmt.value
+            for t in targets:
+                if not isinstance(t, ast.Name):
+                    continue
+                ctor = call_name(value) if isinstance(value, ast.Call) else None
+                if ctor in _THREADING_LOCK_CTORS:
+                    locks.add(t.id)
+                guard = self._guard_comment(lines, stmt)
+                if guard:
+                    guards[t.id] = guard
+        return locks, guards
+
+    def _scan_class(self, cls: ast.ClassDef, lines: list[str]) -> _ClassInfo:
+        info = _ClassInfo(node=cls)
+        for node in ast.walk(cls):
+            if isinstance(node, (ast.Assign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                value = node.value
+                for t in targets:
+                    attr = self_attr(t)
+                    if attr is None:
+                        continue
+                    ctor = (call_name(value)
+                            if isinstance(value, ast.Call) else None)
+                    if ctor in _THREADING_LOCK_CTORS:
+                        info.threading_locks.add(attr)
+                    elif ctor in _ASYNC_LOCK_CTORS:
+                        info.async_locks.add(attr)
+                    guard = self._guard_comment(lines, node)
+                    if guard:
+                        info.guards[attr] = guard
+            elif isinstance(node, ast.Call):
+                self._collect_worker_entry(node, info)
+        # Self-call graph per method (for the `loop` guard closure).
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                calls = {self_attr(n.func)
+                         for n in ast.walk(stmt)
+                         if isinstance(n, ast.Call)
+                         and self_attr(n.func) is not None}
+                info.self_calls[stmt.name] = {c for c in calls if c}
+        return info
+
+    @staticmethod
+    def _collect_worker_entry(node: ast.Call, info: _ClassInfo) -> None:
+        name = call_name(node)
+        if name and name.split(".")[-1] == "to_thread" and node.args:
+            attr = self_attr(node.args[0])
+            if attr:
+                info.worker_entries.add(attr)
+        if name and name.split(".")[-1] == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    attr = self_attr(kw.value)
+                    if attr:
+                        info.worker_entries.add(attr)
+
+    # -- checks --------------------------------------------------------------
+    def _check_class(self, info: _ClassInfo, mod_locks: set[str],
+                     relpath: str, findings: list[Finding]) -> None:
+        # Module-level locks are covered by the module-wide pass; here only
+        # the class's own `self.<lock>` attributes (no double reports).
+        self._check_awaits_under_lock(
+            info.node, set(), info.threading_locks, relpath, findings)
+
+        lock_guards = {a: l for a, l in info.guards.items() if l != "loop"}
+        loop_guards = {a for a, l in info.guards.items() if l == "loop"}
+
+        for stmt in info.node.body:
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if stmt.name == "__init__":
+                continue        # object hasn't escaped; no lock needed yet
+            self._check_guarded_mutations(
+                stmt, lock_guards, is_self=True, relpath=relpath,
+                findings=findings)
+
+        if loop_guards:
+            reachable = self._worker_reachable(info)
+            for stmt in info.node.body:
+                if (isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+                        and stmt.name in reachable):
+                    for node, attr in self._mutations(stmt, is_self=True):
+                        if attr in loop_guards:
+                            findings.append(self.finding(
+                                relpath, node,
+                                f"self.{attr} is `guarded-by: loop` "
+                                f"(event-loop thread only) but is mutated in "
+                                f"worker-thread-reachable method "
+                                f"{stmt.name}()"))
+
+    @staticmethod
+    def _worker_reachable(info: _ClassInfo) -> set[str]:
+        seen: set[str] = set()
+        frontier = [m for m in info.worker_entries if m in info.self_calls]
+        while frontier:
+            m = frontier.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            frontier.extend(c for c in info.self_calls.get(m, ())
+                            if c in info.self_calls and c not in seen)
+        return seen
+
+    def _check_awaits_under_lock(self, root: ast.AST, mod_locks: set[str],
+                                 self_locks: set[str], relpath: str,
+                                 findings: list[Finding]) -> None:
+        for node in ast.walk(root):
+            if not isinstance(node, ast.With):
+                continue
+            if not any(self._is_threading_lock(item.context_expr, mod_locks,
+                                               self_locks)
+                       for item in node.items):
+                continue
+            for inner in ast.walk(node):
+                if isinstance(inner, ast.Await):
+                    findings.append(self.finding(
+                        relpath, inner,
+                        "await while holding a threading.Lock parks the "
+                        "event loop on a lock no coroutine can release; "
+                        "use asyncio.Lock or release before awaiting"))
+
+    @staticmethod
+    def _is_threading_lock(expr: ast.AST, mod_locks: set[str],
+                           self_locks: set[str]) -> bool:
+        attr = self_attr(expr)
+        if attr is not None:
+            return attr in self_locks
+        return isinstance(expr, ast.Name) and expr.id in mod_locks
+
+    def _check_module_guards(self, tree: ast.Module, mod_locks: set[str],
+                             mod_guards: dict[str, str], relpath: str,
+                             findings: list[Finding]) -> None:
+        if not mod_guards:
+            return
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._check_guarded_mutations(
+                    node, mod_guards, is_self=False, relpath=relpath,
+                    findings=findings)
+
+    def _check_guarded_mutations(self, fn: ast.AST, guards: dict[str, str],
+                                 *, is_self: bool, relpath: str,
+                                 findings: list[Finding]) -> None:
+        """Flag mutations of guarded targets in ``fn`` that have no
+        enclosing ``with <lock>`` block naming the documented lock."""
+        if not guards:
+            return
+        held_stack: list[set[str]] = [set()]
+
+        def locks_of(with_node) -> set[str]:
+            out = set()
+            for item in with_node.items:
+                name = (self_attr(item.context_expr) if is_self
+                        else (item.context_expr.id
+                              if isinstance(item.context_expr, ast.Name)
+                              else None))
+                if name:
+                    out.add(name)
+            return out
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                held_stack.append(held_stack[-1] | locks_of(node))
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                held_stack.pop()
+                return
+            for mnode, attr in self._direct_mutations(node, is_self=is_self):
+                lock = guards.get(attr)
+                if lock and lock != "loop" and lock not in held_stack[-1]:
+                    target = f"self.{attr}" if is_self else attr
+                    findings.append(self.finding(
+                        relpath, mnode,
+                        f"{target} is `guarded-by: {lock}` but is mutated "
+                        f"outside a `with {'self.' if is_self else ''}{lock}` "
+                        f"block"))
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        for child in ast.iter_child_nodes(fn):
+            visit(child)
+
+    def _mutations(self, fn: ast.AST, *, is_self: bool):
+        for node in ast.walk(fn):
+            yield from self._direct_mutations(node, is_self=is_self)
+
+    @staticmethod
+    def _direct_mutations(node: ast.AST, *, is_self: bool):
+        """(node, attr) pairs for mutations performed *by this node itself*
+        (not its subtree): assignment/del of the target or a subscript of
+        it, augmented assignment, or a mutating method call on it."""
+        def target_name(expr: ast.AST) -> str | None:
+            if isinstance(expr, ast.Subscript):
+                expr = expr.value
+            if is_self:
+                return self_attr(expr)
+            return expr.id if isinstance(expr, ast.Name) else None
+
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                name = target_name(t)
+                if name:
+                    yield node, name
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                name = target_name(t)
+                if name:
+                    yield node, name
+        elif (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _MUTATORS):
+            name = target_name(node.func.value)
+            if name:
+                yield node, name
+
+
+RULE = LockDisciplineRule()

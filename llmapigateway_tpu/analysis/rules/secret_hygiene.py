@@ -1,0 +1,95 @@
+"""secret-hygiene: provider credentials flowing into log calls.
+
+The gateway holds real provider API keys (providers.json / env vars) and
+forwards client bearer tokens; one careless ``logger.info`` puts them in
+the rotating JSON log file and every log aggregator downstream. This rule
+flags log-call arguments — positional, f-string interpolations, and
+``extra=`` dict values — that reference a name matching the secret
+pattern (``api_key``/``apikey``/``secret``/``password``/
+``authorization``/``bearer``/``credential``), unless the value is wrapped
+in a masking/redaction call (``mask_headers(...)``, ``redact(...)``).
+
+Name-based, deliberately: taint tracking through locals is out of scope
+for an AST pass, but this codebase's convention is that secrets keep
+their secret-shaped names (``self.api_key``, ``pd.apikey``), so the
+lexical check catches the realistic leak shapes.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Finding, Rule
+
+_SECRET_RE = re.compile(
+    r"(?i)(?:^|_)(api_?key|secret|passw(?:or)?d|authorization|bearer|"
+    r"credential|access_token)(?:$|_)")
+_SANITIZER_RE = re.compile(r"(?i)(mask|redact|fingerprint|hash)")
+
+_LOG_METHODS = frozenset({"debug", "info", "warning", "warn", "error",
+                          "exception", "critical", "log"})
+_LOG_OBJECTS = frozenset({"logger", "logging", "log", "_logger"})
+
+
+def _terminal_name(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_log_call(node: ast.Call) -> bool:
+    func = node.func
+    if not isinstance(func, ast.Attribute) or func.attr not in _LOG_METHODS:
+        return isinstance(func, ast.Name) and func.id == "print"
+    base = func.value
+    base_name = _terminal_name(base)
+    return base_name is not None and (base_name in _LOG_OBJECTS
+                                      or base_name.endswith("logger"))
+
+
+class SecretHygieneRule(Rule):
+    name = "secret-hygiene"
+    description = ("secret-named values (api keys, bearer tokens, "
+                   "passwords) passed to logging calls or interpolated "
+                   "into logged f-strings")
+
+    def check(self, tree: ast.Module, source: str,
+              relpath: str) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _is_log_call(node):
+                exprs = list(node.args)
+                exprs += [kw.value for kw in node.keywords]
+                for expr in exprs:
+                    self._check_expr(expr, relpath, findings)
+        return findings
+
+    def _check_expr(self, expr: ast.AST, relpath: str,
+                    findings: list[Finding]) -> None:
+        for node, sanitized in _walk_sanitized(expr):
+            if sanitized:
+                continue
+            name = _terminal_name(node)
+            if name and _SECRET_RE.search(name):
+                findings.append(self.finding(
+                    relpath, node,
+                    f"secret-named value {name!r} reaches a log call; log a "
+                    f"masked form (cf. utils.logging_setup.mask_headers) "
+                    f"or drop it"))
+
+
+def _walk_sanitized(expr: ast.AST, sanitized: bool = False):
+    """Yield (node, under_sanitizer) for every node, marking subtrees
+    wrapped in a masking/redaction call as sanitized."""
+    if isinstance(expr, ast.Call):
+        func_name = _terminal_name(expr.func) or ""
+        if _SANITIZER_RE.search(func_name):
+            sanitized = True
+    yield expr, sanitized
+    for child in ast.iter_child_nodes(expr):
+        yield from _walk_sanitized(child, sanitized)
+
+
+RULE = SecretHygieneRule()

@@ -1,0 +1,93 @@
+"""sse-protocol: malformed Server-Sent-Events frames at yield sites.
+
+Every byte the streaming path yields must be a complete SSE event:
+``data: ``-framed lines terminated by a blank line (``\\n\\n``). A frame
+missing its terminator silently concatenates with the next event in the
+client's parser; a bare payload line (no ``data: `` prefix) is dropped by
+conforming clients — both are protocol corruptions that no test notices
+until a real OpenAI-client consumer hangs. The SSE spec also allows
+``:`` comment lines (keep-alives) and ``event:``/``id:``/``retry:``
+fields, so those pass.
+
+Checked statically where it's checkable: yields of string/bytes
+*literals*, f-strings, and ``"...".encode()`` in the streaming modules
+(``utils/sse.py``, ``server/chat.py``, ``providers/local.py``,
+``providers/remote_http.py``). Yields of names and non-literal calls pass
+— ``format_sse(...)`` is the one sanctioned frame constructor and
+dynamic values can't be verified lexically.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Rule
+
+_FIELD_PREFIXES = ("data:", "event:", "id:", "retry:", ":")
+
+
+def _frame_problem(text: str) -> str | None:
+    """None if ``text`` is a well-formed complete SSE event, else why not."""
+    if not text.endswith("\n\n"):
+        return ("SSE event is not terminated by a blank line (must end "
+                "with \\n\\n)")
+    body = text[:-2]
+    for line in body.split("\n"):
+        if line and not line.startswith(_FIELD_PREFIXES):
+            return (f"SSE line {line.split(chr(10))[0][:40]!r} has no "
+                    f"'data: ' (or other field) framing; conforming "
+                    f"clients drop it")
+    if not any(line.startswith(("data:", ":")) for line in body.split("\n")):
+        return "SSE event carries no 'data:' line"
+    return None
+
+
+def _literal_text(node: ast.AST) -> str | None:
+    """The static text of a yield value, where one exists: a str/bytes
+    constant, a ``"...".encode()`` call, or an f-string with literal
+    framing (interpolated spans count as opaque payload)."""
+    if isinstance(node, ast.Constant):
+        if isinstance(node.value, bytes):
+            try:
+                return node.value.decode("utf-8")
+            except UnicodeDecodeError:
+                return None
+        if isinstance(node.value, str):
+            return node.value
+        return None
+    if (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "encode"):
+        return _literal_text(node.func.value)
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("x")       # opaque interpolation, payload-safe
+        return "".join(parts)
+    return None
+
+
+class SSEProtocolRule(Rule):
+    name = "sse-protocol"
+    description = ("yield sites in the streaming path emitting events "
+                   "without 'data: ' framing or the blank-line terminator")
+    files = ("utils/sse.py", "server/chat.py", "providers/local.py",
+             "providers/remote_http.py")
+
+    def check(self, tree: ast.Module, source: str,
+              relpath: str) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Yield) or node.value is None:
+                continue
+            text = _literal_text(node.value)
+            if text is None:
+                continue        # names/calls: format_sse et al., unverifiable
+            problem = _frame_problem(text)
+            if problem:
+                findings.append(self.finding(relpath, node, problem))
+        return findings
+
+
+RULE = SSEProtocolRule()

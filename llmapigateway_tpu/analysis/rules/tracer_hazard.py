@@ -1,0 +1,134 @@
+"""tracer-hazard: host round-trips and Python control flow in traced code.
+
+Inside a ``jax.jit``/``shard_map``/``lax.scan`` body every array is a
+tracer: ``np.asarray``/``jax.device_get``/``.item()`` force a host sync
+(or fail outright), and Python ``if``/``while``/``for`` over traced values
+either raises a ConcretizationError or — worse — silently bakes one
+branch into the compiled program and recompiles per shape. A hidden host
+round-trip in the decode scan body is exactly the class of regression
+that costs a benchmark round (DistServe-style decode loops only pay off
+host-free, PAPERS.md), so this rule gates ``engine/`` and ``ops/``.
+
+Detection is lexical: a function is considered traced when it is
+decorated with jit/shard_map (directly or via ``functools.partial``), or
+its name is passed to a ``jax.jit(...)`` / ``lax.scan(...)`` /
+``shard_map(...)`` call in the same module. Branch/iteration hazards are
+flagged only when the condition/iterable contains a ``jnp.``/``jax.``
+*call* — branching on static Python config stays legal.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Rule
+from ._util import call_name, contains_call_rooted_at
+
+_JAX_ROOTS = frozenset({"jnp", "jax", "lax"})
+
+# Call suffixes that mark the *wrapped function* as traced.
+_TRACING_WRAPPERS = ("jit", "shard_map", "scan", "pmap", "vmap",
+                     "while_loop", "fori_loop", "checkpoint", "remat")
+
+_HOST_SYNC_CALLS = {
+    "np.asarray": "np.asarray() inside a traced body forces a host sync "
+                  "(or fails on a tracer); use jnp",
+    "np.array": "np.array() inside a traced body forces a host sync "
+                "(or fails on a tracer); use jnp",
+    "onp.asarray": "host numpy call inside a traced body",
+    "jax.device_get": "jax.device_get() inside a traced body is a host sync",
+    "jax.block_until_ready":
+        "jax.block_until_ready() inside a traced body is a host sync",
+}
+
+
+def _wrapper_suffix(name: str | None) -> bool:
+    return bool(name) and name.split(".")[-1] in _TRACING_WRAPPERS
+
+
+def _decorator_traces(dec: ast.AST) -> bool:
+    """``@jax.jit``, ``@jit``, ``@partial(jax.jit, ...)``,
+    ``@jax.jit(...)``, ``@shard_map(...)`` — all mark the def as traced."""
+    if _wrapper_suffix(call_name(dec) if isinstance(dec, ast.Call)
+                       else _dotted(dec)):
+        return True
+    if isinstance(dec, ast.Call):
+        name = call_name(dec)
+        if name and name.split(".")[-1] == "partial":
+            return any(_wrapper_suffix(_dotted(a)) for a in dec.args)
+    return False
+
+
+def _dotted(node: ast.AST) -> str | None:
+    from ._util import dotted_name
+    return dotted_name(node)
+
+
+class TracerHazardRule(Rule):
+    name = "tracer-hazard"
+    description = ("host syncs (np.asarray, device_get, .item()) and Python "
+                   "branching/iteration on traced values inside "
+                   "jit/shard_map/scan bodies in engine/ and ops/")
+    dirs = ("engine", "ops")
+
+    def check(self, tree: ast.Module, source: str,
+              relpath: str) -> list[Finding]:
+        traced_names = self._collect_traced_names(tree)
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if (node.name in traced_names
+                    or any(_decorator_traces(d) for d in node.decorator_list)):
+                self._check_traced_body(node, relpath, findings)
+        return findings
+
+    @staticmethod
+    def _collect_traced_names(tree: ast.Module) -> set[str]:
+        """Function names passed (as bare names) to jit/scan/shard_map
+        calls anywhere in the module."""
+        names: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _wrapper_suffix(call_name(node)):
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    if isinstance(arg, ast.Name):
+                        names.add(arg.id)
+        return names
+
+    def _check_traced_body(self, fn: ast.AST, relpath: str,
+                           findings: list[Finding]) -> None:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name in _HOST_SYNC_CALLS:
+                    findings.append(self.finding(
+                        relpath, node, _HOST_SYNC_CALLS[name]))
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item"
+                        and not node.args and not node.keywords):
+                    findings.append(self.finding(
+                        relpath, node,
+                        ".item() inside a traced body is a host sync"))
+                elif (isinstance(node.func, ast.Name)
+                        and node.func.id in ("float", "int", "bool")
+                        and node.args
+                        and contains_call_rooted_at(node.args[0], _JAX_ROOTS)):
+                    findings.append(self.finding(
+                        relpath, node,
+                        f"{node.func.id}() of a traced value concretizes the "
+                        "tracer (host sync / ConcretizationError)"))
+            elif isinstance(node, (ast.If, ast.While)):
+                if contains_call_rooted_at(node.test, _JAX_ROOTS):
+                    kind = "if" if isinstance(node, ast.If) else "while"
+                    findings.append(self.finding(
+                        relpath, node,
+                        f"Python `{kind}` on a traced value bakes one branch "
+                        "into the compiled program; use jnp.where/lax.cond"))
+            elif isinstance(node, ast.For):
+                if contains_call_rooted_at(node.iter, _JAX_ROOTS):
+                    findings.append(self.finding(
+                        relpath, node,
+                        "Python iteration over a traced value unrolls or "
+                        "concretizes; use lax.scan/fori_loop"))
+
+
+RULE = TracerHazardRule()

@@ -103,9 +103,11 @@ def cross_validate(providers: dict[str, ProviderDetails],
     for name, pd in providers.items():
         if pd.type == "remote_http" and pd.apikey and pd.apikey == pd.apikey.upper() \
                 and not os.environ.get(pd.apikey) and "KEY" in pd.apikey:
+            # The guard above means pd.apikey is an ALL-CAPS env-var NAME
+            # (unset), not a credential — logging it is the diagnostic.
             logger.warning(
                 "provider %s: apikey %r looks like an env-var name but is not set; "
-                "it will be sent as a literal key", name, pd.apikey)
+                "it will be sent as a literal key", name, pd.apikey)  # graftlint: disable=secret-hygiene
 
 
 class ConfigLoader:
@@ -121,9 +123,10 @@ class ConfigLoader:
         self.config_dir = Path(config_dir)
         self.fallback_provider = fallback_provider
         self._lock = threading.Lock()
-        self._providers: dict[str, ProviderDetails] = {}
-        self._rules: dict[str, ModelFallbackConfig] = {}
-        self._version = 0           # bumped on every successful (re)load
+        self._providers: dict[str, ProviderDetails] = {}    # guarded-by: _lock
+        self._rules: dict[str, ModelFallbackConfig] = {}    # guarded-by: _lock
+        # Bumped on every successful (re)load.
+        self._version = 0           # guarded-by: _lock
         if require_files:
             self.load()
 

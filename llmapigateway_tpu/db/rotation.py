@@ -27,7 +27,10 @@ class RotationDB:
         path.mkdir(parents=True, exist_ok=True)
         self._path = path / "rotation.db"
         self._lock = threading.Lock()
-        self._conn = sqlite3.connect(self._path, check_same_thread=False)
+        # One shared connection; every statement runs under the lock
+        # (check_same_thread=False makes cross-thread use legal, not safe).
+        self._conn = sqlite3.connect(self._path,
+                                     check_same_thread=False)  # guarded-by: _lock
         with self._lock:
             self._conn.execute(
                 """CREATE TABLE IF NOT EXISTS model_rotation (

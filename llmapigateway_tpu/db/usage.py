@@ -50,7 +50,10 @@ class UsageDB:
         path.mkdir(parents=True, exist_ok=True)
         self._path = path / "tokens_usage.db"
         self._lock = threading.Lock()
-        self._conn = sqlite3.connect(self._path, check_same_thread=False)
+        # One shared connection; every statement runs under the lock
+        # (check_same_thread=False makes cross-thread use legal, not safe).
+        self._conn = sqlite3.connect(self._path,
+                                     check_same_thread=False)  # guarded-by: _lock
         self._conn.row_factory = sqlite3.Row
         with self._lock:
             self._conn.execute(

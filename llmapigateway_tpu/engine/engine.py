@@ -341,12 +341,16 @@ class InferenceEngine:
                     "(programs compile lazily on first call)",
                     t1 - t0, time.monotonic() - t1)
 
+        # Scheduler state is event-loop-thread ONLY (asyncio.Queue and the
+        # slot maps are not thread-safe; worker-thread calls touch device
+        # programs and host numpy mirrors, never these) — the `guarded-by:
+        # loop` marks make graftlint's lock-discipline rule enforce that.
         self._queue: asyncio.Queue[GenRequest] = asyncio.Queue(
-            maxsize=max(2 * self.B, 16))
-        self._head: GenRequest | None = None   # FIFO head awaiting admission
-        self._free_slots = list(range(self.B))
-        self._running: dict[int, GenRequest] = {}
-        self._prefilling: dict[int, GenRequest] = {}
+            maxsize=max(2 * self.B, 16))                # guarded-by: loop
+        self._head: GenRequest | None = None            # guarded-by: loop
+        self._free_slots = list(range(self.B))          # guarded-by: loop
+        self._running: dict[int, GenRequest] = {}       # guarded-by: loop
+        self._prefilling: dict[int, GenRequest] = {}    # guarded-by: loop
         self._loop_task: asyncio.Task | None = None
         self._stopped = False
         self._work_event = asyncio.Event()
@@ -1235,6 +1239,11 @@ class InferenceEngine:
                    else self._prefill_k_rungs[0])
         if batch_k <= 1 or len(eligible) <= 1:
             for req in eligible:
+                if req.cancelled:
+                    # Cancelled during an earlier request's await this tick:
+                    # don't burn one more prefill chunk on a dead client.
+                    self._finish(req, "cancelled", emit=False)
+                    continue
                 prompt_done = await asyncio.to_thread(
                     self._prefill_one_chunk, req)
                 if prompt_done:
@@ -1248,7 +1257,22 @@ class InferenceEngine:
                 bucket = min(_bucket(ch, self.prefill_chunk), self.S - pos)
                 groups.setdefault(bucket, []).append(req)
             for reqs in groups.values():
-                for batch in self.prefill_groups(reqs):
+                pending = reqs
+                while pending:
+                    # Re-check cancellation per dispatch: a cancel that
+                    # landed during a previous group's await must not burn
+                    # one more prefill chunk, and dropping it here lets the
+                    # survivors re-snap to a smaller compiled K rung.
+                    live: list[GenRequest] = []
+                    for req in pending:
+                        if req.cancelled:
+                            self._finish(req, "cancelled", emit=False)
+                        else:
+                            live.append(req)
+                    if not live:
+                        break
+                    batch = self.prefill_groups(live)[0]
+                    pending = live[len(batch):]
                     dones = await asyncio.to_thread(
                         self._prefill_chunk_group, batch)
                     for req, prompt_done in zip(batch, dones):

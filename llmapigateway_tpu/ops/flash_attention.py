@@ -40,6 +40,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..utils.jax_compat import shard_map
+
 NEG_INF = -1e30
 
 
@@ -505,7 +507,7 @@ def make_sharded_cache_attention_fn(mesh, block_s: int | None = None,
         # shard_map signature is static.
         act = active if active is not None \
             else jnp.ones((q.shape[0],), bool)
-        f = jax.shard_map(
+        f = shard_map(
             lambda q_, kn, vn, lk, lv, ln, ac:
                 base(q_, kn, vn, lk, lv, ln, ac),
             mesh=mesh,
@@ -524,7 +526,7 @@ def make_sharded_cache_attention_fn(mesh, block_s: int | None = None,
         slot = P(data)
         act = active if active is not None \
             else jnp.ones((q.shape[0],), bool)
-        f = jax.shard_map(
+        f = shard_map(
             lambda q_, kn, vn, lk, lv, ln, ac:
                 base.decode(q_, kn, vn, lk, lv, ln, ac),
             mesh=mesh,

@@ -41,7 +41,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..models.config import ModelConfig
-from .flash_attention import attend_block, self_column_init, unpack_kv_refs
+from .flash_attention import (attend_block, self_column_init, shard_map,
+                              unpack_kv_refs)
 
 NEG_INF = -1e30
 
@@ -570,7 +571,7 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
         pool = _pool_spec(layer_k)
         bt = block_t if block_t is not None else min(T & (-T), 128)
         if shard:
-            f = jax.shard_map(
+            f = shard_map(
                 lambda q_, k_, v_, pt_, st_: paged_prefill_attention(
                     q_, k_, v_, pt_, st_, block_t=bt, window=window,
                     interpret=interpret),
@@ -603,7 +604,7 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
         shard = msize > 1 and KV % msize == 0 and H % msize == 0
         pool = _pool_spec(layer_k)
         if shard:
-            f = jax.shard_map(
+            f = shard_map(
                 lambda q_, kn_, vn_, k_, v_, pt_, nv_: paged_decode_attention(
                     q_, kn_, vn_, k_, v_, pt_, nv_, window=window,
                     interpret=interpret),
@@ -723,7 +724,7 @@ def make_seq_paged_attention_fn(page_table: jax.Array, max_seq: int, mesh):
                 return {"q": P(None, None, "seq", None),
                         "s": P(None, None, None, "seq")}
             return P(None, None, "seq", None)
-        return jax.shard_map(
+        return shard_map(
             _gather_local, mesh=mesh,
             in_specs=(_leaf_specs(pool_layer), P()),
             out_specs=out_spec(pool_layer),
@@ -735,7 +736,7 @@ def make_seq_paged_attention_fn(page_table: jax.Array, max_seq: int, mesh):
 
     def sharded_insert(layer_k, layer_v, k_new, v_new, lengths, active):
         act = jnp.ones(lengths.shape, bool) if active is None else active
-        return jax.shard_map(
+        return shard_map(
             _insert_local, mesh=mesh,
             in_specs=(_leaf_specs(layer_k), _leaf_specs(layer_v),
                       P(), P(), P(), P(), P()),
@@ -777,7 +778,7 @@ def make_seq_paged_attention_fn(page_table: jax.Array, max_seq: int, mesh):
 
     def insert_all(pool_k, pool_v, k_news, v_news, lengths, active):
         act = jnp.ones(lengths.shape, bool) if active is None else active
-        return jax.shard_map(
+        return shard_map(
             _insert_all_local, mesh=mesh,
             in_specs=(_leaf_specs(pool_k), _leaf_specs(pool_v),
                       P(), P(), P(), P(), P()),

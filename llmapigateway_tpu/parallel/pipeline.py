@@ -40,6 +40,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..utils.jax_compat import SHARD_MAP_PARTIAL_AUTO_OK, shard_map
+
 from ..models import llama
 from ..models.config import ModelConfig
 
@@ -157,6 +159,7 @@ def _build_run(c: ModelConfig, mesh: Mesh, n_stages: int, M: int, Bm: int,
         param_spec["lm_head_q8"] = P()     # prefix spec covers {q, s}
     paged = make_attention is not None
     in_specs = (
+        P("pipe"),               # stage index [n_stages] -> local [1]
         param_spec,
         P(),                     # tokens (replicated; every stage embeds)
         P(),                     # lengths
@@ -166,10 +169,15 @@ def _build_run(c: ModelConfig, mesh: Mesh, n_stages: int, M: int, Bm: int,
     out_specs = (P(), P("pipe"), P("pipe"))
 
     @functools.partial(
-        jax.shard_map, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        shard_map, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
         axis_names={"pipe"}, check_vma=False)
-    def run(params, tokens, lengths, cache_k, cache_v, active, *table):
-        p = jax.lax.axis_index("pipe")
+    def run(stage, params, tokens, lengths, cache_k, cache_v, active,
+            *table):
+        # The stage id arrives as this stage's shard of an iota input —
+        # NOT jax.lax.axis_index: under a partially-manual shard_map
+        # (auto `model` axis) axis_index lowers to a PartitionId
+        # instruction SPMD partitioning rejects on older jax.
+        p = stage[0]
         lp = params["layers"]                  # [Lp, ...] local block
 
         # Every stage embeds every microbatch (replicated compute, tiny):
@@ -279,6 +287,15 @@ def pipelined_forward(params: dict, config: ModelConfig, tokens: jax.Array,
     """
     B, T = tokens.shape
     n_stages = mesh.shape.get("pipe", 1)
+    if (not SHARD_MAP_PARTIAL_AUTO_OK and n_stages > 1
+            and any(n > 1 for ax, n in mesh.shape.items() if ax != "pipe")):
+        # Refuse BEFORE compile: the legacy partial-auto shard_map
+        # miscompiles this schedule combined with a real second mesh axis
+        # (XLA abort, which would take the whole process down).
+        raise NotImplementedError(
+            "pipeline parallelism combined with another sharded mesh axis "
+            "needs jax.shard_map's partial-auto mode (jax >= 0.5); this "
+            "jax build only supports a pure-pipe mesh")
     stage_size(config.n_layers, n_stages)     # validate divisibility
     M = n_microbatches
     if B % M != 0:
@@ -289,6 +306,7 @@ def pipelined_forward(params: dict, config: ModelConfig, tokens: jax.Array,
                      "lm_head" in params, "lm_head_q8" in params,
                      make_attention)
     extra = () if make_attention is None else (table,)
-    logits, new_k, new_v = run(params, tokens, lengths, cache.k, cache.v,
-                               active, *extra)
+    stage = jnp.arange(n_stages, dtype=jnp.int32)
+    logits, new_k, new_v = run(stage, params, tokens, lengths, cache.k,
+                               cache.v, active, *extra)
     return logits, type(cache)(k=new_k, v=new_v)

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..utils.jax_compat import axis_size, shard_map
+
 NEG_INF = -1e30
 
 
@@ -67,7 +69,7 @@ def _block_attn_accum(q, k, v, q_off, k_off, m, l, acc, *, causal: bool):
 def _ring_body(q, k, v, *, axis: str, causal: bool):
     """Per-shard ring loop (runs inside shard_map, manual over `axis`)."""
     B, Tl, H, Dh = q.shape
-    n = jax.lax.axis_size(axis)
+    n = axis_size(axis)
     idx = jax.lax.axis_index(axis)
     q_off = idx * Tl
 
@@ -108,7 +110,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
         raise ValueError(f"sequence {q.shape[1]} not divisible by "
                          f"{axis}={n}")
     body = functools.partial(_ring_body, axis=axis, causal=causal)
-    f = jax.shard_map(
+    f = shard_map(
         body, mesh=mesh,
         in_specs=(P(None, axis, None, None),) * 3,
         out_specs=P(None, axis, None, None),

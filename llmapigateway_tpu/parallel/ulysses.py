@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..utils.jax_compat import shard_map
+
 NEG_INF = -1e30
 
 
@@ -73,7 +75,7 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array, mesh: Mesh,
             f"Ulysses needs heads divisible by the mesh axis (H={H}, "
             f"KV={KV}, {axis}={n}); use ring_attention for KV < chips")
     body = functools.partial(_ulysses_body, axis=axis, causal=causal)
-    f = jax.shard_map(
+    f = shard_map(
         body, mesh=mesh,
         in_specs=(P(None, axis, None, None),) * 3,
         out_specs=P(None, axis, None, None),

@@ -68,8 +68,14 @@ class LocalProvider(Provider):
         temperature = 1.0 if raw_temp is None else float(raw_temp)
         top_p = float(payload.get("top_p", 1.0) or 1.0)
         top_k = int(payload.get("top_k", 0) or 0)
+        # OpenAI penalty fields (engine/sampling.py apply_penalties). `or 0.0`
+        # also maps explicit null to the no-penalty default.
+        presence = float(payload.get("presence_penalty") or 0.0)
+        frequency = float(payload.get("frequency_penalty") or 0.0)
         return GenRequest(prompt_ids=prompt_ids, max_tokens=max_tokens,
                           temperature=temperature, top_p=top_p, top_k=top_k,
+                          presence_penalty=presence,
+                          frequency_penalty=frequency,
                           stop=[s for s in stop if s])
 
     def _usage(self, req) -> dict[str, Any]:

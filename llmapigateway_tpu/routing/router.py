@@ -67,9 +67,10 @@ class ProviderRegistry:
                  local_factory: Callable[[str, ProviderDetails], Provider] | None = None):
         self._loader = loader
         self._local_factory = local_factory
-        self._cache: dict[str, tuple[str, Provider]] = {}   # name -> (fingerprint, provider)
+        # name -> (fingerprint, provider)
+        self._cache: dict[str, tuple[str, Provider]] = {}   # guarded-by: _lock
         self._lock = asyncio.Lock()
-        self._name_locks: dict[str, asyncio.Lock] = {}
+        self._name_locks: dict[str, asyncio.Lock] = {}      # guarded-by: _lock
         self._retiring: set[asyncio.Task] = set()
         self._closed = False
 

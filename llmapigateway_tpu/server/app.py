@@ -8,6 +8,7 @@ duplicates at import time — SURVEY.md §1 "layering reality").
 """
 from __future__ import annotations
 
+import asyncio
 import logging
 from pathlib import Path
 from typing import Callable
@@ -66,7 +67,8 @@ def _static_page(filename: str):
         path = STATIC_DIR / filename
         if not path.exists():
             return web.json_response({"detail": f"{filename} not found"}, status=404)
-        return web.Response(text=path.read_text(), content_type="text/html")
+        text = await asyncio.to_thread(path.read_text)
+        return web.Response(text=text, content_type="text/html")
     return handler
 
 

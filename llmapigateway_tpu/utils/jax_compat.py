@@ -1,0 +1,54 @@
+"""JAX API compatibility shims.
+
+One home for version-portability so kernel/parallel code reads as if on
+current JAX: every ``shard_map`` site in ops/ and parallel/ calls the
+wrapper below with the new public keyword surface, and the shim maps it
+onto whatever the installed JAX provides.
+"""
+from __future__ import annotations
+
+import jax
+
+try:                        # public API from jax 0.5+
+    from jax import shard_map as _shard_map_impl
+    _SHARD_MAP_NEW_API = True
+except (ImportError, AttributeError):
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+    _SHARD_MAP_NEW_API = False
+
+# Whether partially-manual shard_map (axis_names a strict subset of the
+# mesh axes, the rest left to GSPMD) is trustworthy. The legacy
+# `auto=` form miscompiles programs that combine ppermute/psum with a
+# real (>1) auto axis — observed as an XLA abort (not a Python error)
+# compiling the pipeline schedule with pipe x model — so callers that
+# need real partial-auto must check this and fail cleanly first.
+# Size-1 auto axes are fine either way.
+SHARD_MAP_PARTIAL_AUTO_OK = _SHARD_MAP_NEW_API
+
+
+def axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` where it exists; the classic ``psum(1, axis)``
+    idiom (statically folded to the axis size) on older jax."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(axis_name)
+    return jax.lax.psum(1, axis_name)
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, axis_names=None,
+              check_vma=False):
+    """jax.shard_map with the new keyword surface, runnable on older jax:
+    ``axis_names`` (the axes to go Manual over) maps to the legacy
+    ``auto`` complement, ``check_vma`` to ``check_rep``."""
+    if _SHARD_MAP_NEW_API:
+        kwargs = {"check_vma": check_vma}
+        if axis_names is not None:
+            kwargs["axis_names"] = frozenset(axis_names)
+        return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                               out_specs=out_specs, **kwargs)
+    kwargs = {"check_rep": check_vma}
+    if axis_names is not None:
+        auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        if auto:
+            kwargs["auto"] = auto
+    return _shard_map_impl(f, mesh=mesh, in_specs=in_specs,
+                           out_specs=out_specs, **kwargs)

@@ -163,6 +163,14 @@ _PARITY_RERUN_TESTS = {
 }
 
 
+# Parity-rerun adjudications recorded this session: (nodeid, verdict,
+# detail). Surfaced two ways so subprocess-retry-masked in-process
+# failures stay visible in CI logs: on the passed call report's
+# ``user_properties`` (machine-readable — junitxml emits them) and in a
+# terminal-summary section at the end of the run.
+_PARITY_ADJUDICATIONS: list[tuple[str, str, str]] = []
+
+
 def pytest_runtest_protocol(item, nextitem):
     import subprocess
     import sys
@@ -205,20 +213,48 @@ def pytest_runtest_protocol(item, nextitem):
                 break
         if sub.returncode == 0:
             # Fresh-process pass: replace the failed call report with the
-            # retry's outcome so the suite records the adjudicated result.
+            # retry's outcome so the suite records the adjudicated result —
+            # and stamp the adjudication on the report so the masked
+            # in-process failure stays visible (user_properties + summary).
             for r in reports:
                 if r.when == "call" and r.failed:
+                    orig = str(r.longrepr)[-800:] if r.longrepr else ""
                     r.outcome = "passed"
                     r.longrepr = None
+                    r.user_properties.append(
+                        ("parity_rerun", "adjudicated-pass"))
+                    r.user_properties.append(
+                        ("parity_rerun_masked_failure", orig))
+                    _PARITY_ADJUDICATIONS.append(
+                        (item.nodeid, "adjudicated-pass",
+                         "in-process failure passed in a fresh process "
+                         "(XLA-CPU compile-instance flip)"))
         else:
             sys.stderr.write(
                 f"[parity-rerun] fresh-process retry FAILED (real "
                 f"failure):\n{sub.stdout[-2000:]}\n")
+            for r in reports:
+                if r.when == "call" and r.failed:
+                    r.user_properties.append(
+                        ("parity_rerun", "confirmed-failure"))
+            _PARITY_ADJUDICATIONS.append(
+                (item.nodeid, "confirmed-failure",
+                 "failed in-process AND in the fresh-process retry"))
     for r in reports:
         item.ihook.pytest_runtest_logreport(report=r)
     item.ihook.pytest_runtest_logfinish(nodeid=item.nodeid,
                                         location=item.location)
     return True
+
+
+def pytest_terminal_summary(terminalreporter):
+    """One summary line per parity-rerun adjudication, so a retry-masked
+    failure is never invisible in CI logs (warnings-summary analog)."""
+    if not _PARITY_ADJUDICATIONS:
+        return
+    terminalreporter.write_sep("=", "parity-rerun adjudications")
+    for nodeid, verdict, detail in _PARITY_ADJUDICATIONS:
+        terminalreporter.write_line(f"{verdict}: {nodeid} — {detail}")
 
 
 PROVIDERS_JSON5 = """\
