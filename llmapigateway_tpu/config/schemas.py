@@ -44,6 +44,22 @@ class LocalEngineConfig(BaseModel):
     # the equal-HBM admission math (engine/paged.py).
     kv_page_size: int = 256
     kv_num_pages: int = 0           # 0 → derived from max_batch_size*max_seq_len
+    # Multi-page kernel blocking: fetch this many CONTIGUOUS logical pages
+    # per paged-kernel grid step (one pages_per_block× larger HBM→VMEM
+    # DMA; the kernel grid shrinks by the same factor — the decode
+    # roofline lever at target scale, ISSUE 2). >1 switches the page
+    # allocator to superpage packing (aligned runs of this many physical
+    # pages; up to ppb-1 pages of internal fragmentation per slot) so the
+    # kernels' gather-free index maps stay valid. Falls back to 1 with a
+    # warning when the pool can't be packed (seq-banded pools, the SWA
+    # page ring, or non-divisible page geometry). Numerics are identical
+    # for every value (bit-for-bit vs per-page kernels).
+    kv_pages_per_block: int = 1
+    # Chip HBM peak (GB/s) for the engine's roofline telemetry: with this
+    # set, stats()/the /v1/api/roofline endpoint report achieved GB/s as
+    # a fraction of peak (v5e: 819). 0 = unknown — absolute achieved_gbps
+    # still reports from the bytes-touched model × measured step time.
+    hbm_peak_gbps: float = 0.0
     prefill_chunk: int = 512
     # Max queued admissions prefilled in ONE compiled call (the
     # scheduler groups same-bucket chunks and snaps the group size down

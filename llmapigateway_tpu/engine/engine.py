@@ -428,6 +428,7 @@ class InferenceEngine:
 
     def _init_state(self) -> None:
         c = self.model_cfg
+        self.kv_ppb = 1          # multi-page kernel blocking (paged only)
         if self.paged:
             from ..parallel.sharding import paged_cache_sharding
             from ..ops.paged_attention import PagedKVCache
@@ -460,17 +461,46 @@ class InferenceEngine:
                         "paged SWA ring: %d pages/slot (window %d) instead "
                         "of %d — steady-state KV footprint is O(window)",
                         ring, c.sliding_window, per_slot)
+            # Multi-page kernel blocking (kv_pages_per_block): resolve the
+            # requested run length against what the pool can actually
+            # pack — the allocator's superpage runs are what license the
+            # kernels' gather-free index maps, so any geometry the
+            # allocator can't pack falls back to per-page blocks instead
+            # of serving wrong reads.
+            ppb_req = max(1, self.cfg.kv_pages_per_block)
+            if ppb_req > 1:
+                why = None
+                if n_bands > 1:
+                    why = "seq-banded pool (positions band per chip)"
+                elif self._swa_ring_pages:
+                    why = "SWA page ring (mappings rotate per page)"
+                elif per_slot % ppb_req:
+                    why = (f"pages per slot ({per_slot}) not divisible "
+                           f"by {ppb_req}")
+                elif (self.cfg.kv_num_pages
+                      and self.cfg.kv_num_pages % ppb_req):
+                    why = (f"kv_num_pages ({self.cfg.kv_num_pages}) not "
+                           f"divisible by {ppb_req}")
+                if why is None:
+                    self.kv_ppb = ppb_req
+                else:
+                    logger.warning(
+                        "kv_pages_per_block=%d falls back to per-page "
+                        "blocks: %s", ppb_req, why)
             # One trash page per band (seq-sharded pools redirect masked
-            # writes shard-locally).
+            # writes shard-locally); a PACKED pool reserves the whole
+            # trash superpage instead.
+            n_trash = self.kv_ppb if self.kv_ppb > 1 else n_bands
             num_pages = self.cfg.kv_num_pages or (
-                self.B * per_slot + n_bands)
+                self.B * per_slot + n_trash)
             min_hold = self._swa_ring_pages or per_slot
-            if num_pages - n_bands < min_hold:
+            if num_pages - n_trash < min_hold:
                 raise ValueError(
                     f"kv_num_pages={num_pages} cannot hold one "
                     f"max-footprint sequence ({min_hold} pages of {page})")
             self.allocator = PageAllocator(num_pages, page, self.B, self.S,
-                                           n_bands=n_bands)
+                                           n_bands=n_bands,
+                                           pages_per_block=self.kv_ppb)
             psh = paged_cache_sharding(
                 self.mesh, c.n_kv_heads,
                 n_layers=c.n_layers if self.pipe_n > 1 else None,
@@ -604,6 +634,15 @@ class InferenceEngine:
         self._explore_pending = 0
         self._explore_depth = 0
         self._depth_hist: dict[int, int] = {}
+        # Prefill-aware clamp + queue-wait telemetry (stats()): how often
+        # busy bursts were clamped below decode_burst_busy, the last
+        # depth actually dispatched, and how long admissions waited for a
+        # slot — the scheduler-side counters of the roofline story.
+        self._busy_clamps = 0
+        self._last_burst_depth = 0
+        self._queue_wait_n = 0                          # guarded-by: loop
+        self._queue_wait_ema_ms: float | None = None    # guarded-by: loop
+        self._queue_wait_max_ms = 0.0                   # guarded-by: loop
         # Operator-facing gauge for /v1/api/engine-stats: EMA over ANY
         # steady same-depth burst (wall/depth, per-burst overhead
         # included) — the number an operator compares to the bench.
@@ -848,8 +887,11 @@ class InferenceEngine:
 
         impl = self._resolve_attention_impl()
         mesh = self.mesh if self.mesh.size > 1 else None
-        logger.info("paged KV cache: %d pages × %d tokens, attention=%s",
-                    self.allocator.num_pages, self.allocator.page_size, impl)
+        logger.info("paged KV cache: %d pages × %d tokens, attention=%s"
+                    "%s", self.allocator.num_pages,
+                    self.allocator.page_size, impl,
+                    (f", pages_per_block={self.kv_ppb}"
+                     if self.kv_ppb > 1 else ""))
         S = self.S
 
         replicated = NamedSharding(self.mesh, P())
@@ -862,7 +904,8 @@ class InferenceEngine:
             # memo, hence ONE partial per engine.
             make_attn = partial(make_paged_attention_fn, max_seq=S,
                                 impl=impl, mesh=mesh,
-                                window=c.sliding_window)
+                                window=c.sliding_window,
+                                pages_per_block=self.kv_ppb)
             pipe_fwd = _pipelined_family_forward(self.mesh, self.pipe_n,
                                                  make_attention=make_attn)
 
@@ -896,7 +939,8 @@ class InferenceEngine:
                              active=None, prefill=False):
                 attn = make_paged_attention_fn(table, max_seq=S, impl=impl,
                                                mesh=mesh,
-                                               window=c.sliding_window)
+                                               window=c.sliding_window,
+                                               pages_per_block=self.kv_ppb)
                 return family_forward(params, c, tokens, lengths, cache,
                                       active=active, attention_fn=attn)
 
@@ -1206,6 +1250,14 @@ class InferenceEngine:
                     break
             self._head = None
             req.slot = self._free_slots.pop()
+            # Queue-wait gauge (submit → slot admission): the scheduler
+            # half of TTFT — what the prefill-aware burst clamp bounds.
+            wait_ms = 1000.0 * (time.monotonic() - req.t_submit)
+            self._queue_wait_n += 1
+            self._queue_wait_ema_ms = (
+                wait_ms if self._queue_wait_ema_ms is None
+                else 0.8 * self._queue_wait_ema_ms + 0.2 * wait_ms)
+            self._queue_wait_max_ms = max(self._queue_wait_max_ms, wait_ms)
             if self.spec_k:
                 # New text in this slot: acceptance starts unmeasured.
                 # (Reset at ADMISSION, not release, so stats keep the last
@@ -1287,7 +1339,12 @@ class InferenceEngine:
         decoding = [r for r in self._running.values()
                     if not r.done and r.slot not in self._prefilling]
         if decoding:
-            busy = not self._queue.empty() or bool(self._prefilling)
+            # Prefill-aware (DistServe/Sarathi-style interleave): any
+            # admission waiting — queued, parked at the FIFO head for a
+            # page reservation, or mid-chunked-prefill — clamps the next
+            # burst so prefill work never starves behind a deep scan.
+            busy = (self._head is not None or not self._queue.empty()
+                    or bool(self._prefilling))
             # Speculation verifies against argmax, so it engages only while
             # EVERY active slot is greedy (the common serving case);
             # sampled requests flip the whole batch to the normal burst
@@ -2101,16 +2158,38 @@ class InferenceEngine:
         to a compiled scan depth (``_burst_depths``): an arbitrary
         depth would fall off the fused-scan fast path onto per-step
         dispatch. Until the model has a sample, run the configured
-        depth — the first bursts are the measurement."""
+        depth — the first bursts are the measurement.
+
+        Busy bursts are ALSO step-time-aware when a TTFT target is set
+        (the prefill-aware clamp, ISSUE 2): at target scale a step costs
+        ~23 ms, so even the configured busy depth can spend several
+        hundred ms between prefill chunks — each chunk of a queued
+        admission then waits out a full busy burst, and a multi-chunk
+        prompt accumulates that into the 742.8 ms p50 measured in r5b.
+        The clamp caps a busy burst at a QUARTER of the target (the
+        interleave runs once per chunk; prefill + flush spend the rest),
+        dropping below ``decode_burst_busy`` — to the synchronous
+        burst=1 path if nothing compiled fits — while leaving idle-queue
+        bursts at the unchanged deep/capped depth."""
         if busy:
             # A busy interleave splits an in-progress exploration pair —
             # its second burst would run against a busy-depth
             # predecessor and record nothing. Cancel rather than spend
             # the deep-burst TTFT exposure for no sample.
             self._explore_pending = 0
-            self._depth_hist[self.decode_burst_busy] = \
-                self._depth_hist.get(self.decode_burst_busy, 0) + 1
-            return self.decode_burst_busy
+            pick = self.decode_burst_busy
+            if self.ttft_target_ms > 0:
+                est = self._step_ms_estimate()
+                if est:
+                    cap = 0.25 * self.ttft_target_ms / est
+                    if cap < pick:
+                        fitting = [d for d in self._burst_depths
+                                   if d <= cap]
+                        pick = max(fitting) if fitting else 1
+                        self._busy_clamps += 1
+            self._last_burst_depth = pick
+            self._depth_hist[pick] = self._depth_hist.get(pick, 0) + 1
+            return pick
         pick = self.decode_burst
         if self.ttft_target_ms > 0:
             est = self._step_ms_estimate()
@@ -2137,6 +2216,7 @@ class InferenceEngine:
                         self._explore_depth = deeper[0]
                         self._explore_pending = 1
                         pick = self._explore_depth
+        self._last_burst_depth = pick
         self._depth_hist[pick] = self._depth_hist.get(pick, 0) + 1
         return pick
 
@@ -2369,6 +2449,38 @@ class InferenceEngine:
                 self._table_dirty = True
 
     # -- stats ----------------------------------------------------------------
+    def _resident_param_bytes(self) -> int:
+        """HBM bytes one decode step streams for WEIGHTS: every resident
+        leaf read once per step (scales included — they move over the bus
+        too; int4 packs two elements per byte). Cached — the tree never
+        changes after init."""
+        b = getattr(self, "_param_bytes_cache", None)
+        if b is None:
+            b = 0
+            for leaf in jax.tree.leaves(self.params):
+                itemsize = (0.5 if leaf.dtype == jnp.int4
+                            else leaf.dtype.itemsize)
+                b = b + int(np.prod(leaf.shape) * itemsize)
+            self._param_bytes_cache = b
+        return b
+
+    def _kv_bytes_per_step(self) -> int:
+        """HBM bytes one decode step reads from the KV cache: the live
+        (window-clamped) stale prefix of every active slot, K and V, at
+        the cache's element width (int8-KV: 1 B + the per-token fp32
+        scale amortized over head_dim). The bytes-touched half of the
+        roofline model — achieved GB/s = (weights + this) / step time."""
+        c = self.model_cfg
+        live = self.lengths[self.active].astype(np.int64)
+        if c.sliding_window:
+            live = np.minimum(live, c.sliding_window)
+        if self.kv_quant:
+            elem = 1.0 + 4.0 / c.head_dim
+        else:
+            elem = float(jnp.dtype(self.dtype).itemsize)
+        return int(2 * c.n_layers * c.n_kv_heads * c.head_dim * elem
+                   * int(live.sum()))
+
     def stats(self) -> dict[str, Any]:
         out = {
             "running": len(self._running),
@@ -2387,8 +2499,12 @@ class InferenceEngine:
         if self.paged:
             out["free_pages"] = self.allocator.free_pages
             out["total_pages"] = (self.allocator.num_pages
-                                  - self.allocator.n_bands)
+                                  - (self.allocator.pages_per_block
+                                     if self.allocator.pages_per_block > 1
+                                     else self.allocator.n_bands))
             out["page_size"] = self.allocator.page_size
+            if self.kv_ppb > 1:
+                out["pages_per_block"] = self.kv_ppb
         gauge = (self._ema_step_ms_stats
                  if self._ema_step_ms_stats is not None
                  else self._step_ms_estimate())
@@ -2397,6 +2513,27 @@ class InferenceEngine:
             active_n = int(self.active.sum())
             if active_n:
                 out["decode_tok_s"] = round(1000.0 * active_n / gauge, 1)
+        # Roofline counters (ISSUE 2): bytes one decode step must stream
+        # (weights + live KV) and the achieved bandwidth that implies at
+        # the measured step time — the number the bench ladder and the
+        # stats UI both read, so the 0.478→1.0 roofline trajectory is a
+        # reading instead of a post-hoc reconstruction.
+        hbm_bytes = self._resident_param_bytes() + self._kv_bytes_per_step()
+        out["hbm_bytes_per_step"] = hbm_bytes
+        if gauge:
+            out["achieved_gbps"] = round(hbm_bytes / (gauge / 1e3) / 1e9, 1)
+            if self.cfg.hbm_peak_gbps > 0:
+                out["roofline_fraction"] = round(
+                    out["achieved_gbps"] / self.cfg.hbm_peak_gbps, 3)
+        # Scheduler-side TTFT counters: where bursts ran, how often the
+        # prefill-aware clamp bit, and how long admissions waited.
+        if self._last_burst_depth:
+            out["burst_depth_last"] = self._last_burst_depth
+        out["burst_busy_clamps"] = self._busy_clamps
+        if self._queue_wait_n:
+            out["queue_wait_ms_ema"] = round(self._queue_wait_ema_ms, 1)
+            out["queue_wait_ms_max"] = round(self._queue_wait_max_ms, 1)
+            out["queue_waits"] = self._queue_wait_n
         # Burst-depth controller diagnostics (ttft_target_ms): fitted
         # per-step slope, per-burst fixed cost, and where bursts actually
         # ran — the fields that turn an on-chip TTFT/throughput anomaly

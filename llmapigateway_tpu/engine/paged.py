@@ -29,7 +29,8 @@ class PageAllocator:
     redirect must stay shard-local) and is never allocated."""
 
     def __init__(self, num_pages: int, page_size: int, batch: int,
-                 max_seq: int, n_bands: int = 1):
+                 max_seq: int, n_bands: int = 1,
+                 pages_per_block: int = 1):
         if num_pages < 2 * n_bands:
             raise ValueError(f"need at least {2 * n_bands} pages "
                              f"({n_bands} band trash pages reserved)")
@@ -49,12 +50,43 @@ class PageAllocator:
         self.band_pages = num_pages // n_bands      # physical pages per band
         self.pages_per_slot = (max_seq + page_size - 1) // page_size
         self.slot_band_pages = self.pages_per_slot // n_bands
+        # SUPERPAGE PACKING (pages_per_block > 1): allocation happens in
+        # aligned runs of `pages_per_block` contiguous physical pages, and
+        # every aligned group of logical pages maps onto one such run —
+        # the invariant the multi-page Pallas kernels' gather-free index
+        # maps rely on (ops/paged_attention.py _check_pages_per_block).
+        # Superpage 0 (which contains trash page 0) is never allocated,
+        # so the trash-group read of a dead iteration only ever sees
+        # trash bytes. Costs up to ppb-1 pages of internal fragmentation
+        # per slot (pages_needed rounds up to whole runs).
+        self.pages_per_block = max(1, pages_per_block)
+        if self.pages_per_block > 1:
+            if n_bands > 1:
+                raise ValueError("superpage packing is single-band only "
+                                 "(paged × seq keeps per-page blocks)")
+            if num_pages % self.pages_per_block:
+                raise ValueError(
+                    f"num_pages {num_pages} not divisible by "
+                    f"pages_per_block {self.pages_per_block}")
+            if self.pages_per_slot % self.pages_per_block:
+                raise ValueError(
+                    f"pages_per_slot {self.pages_per_slot} not divisible "
+                    f"by pages_per_block {self.pages_per_block} (table "
+                    f"rows must split into whole runs)")
         # Per-band free lists, excluding each band's trash page (its first
         # physical id). LIFO: recently-freed pages are likely still warm.
-        self._free: list[list[int]] = [
-            list(range((b + 1) * self.band_pages - 1,
-                       b * self.band_pages, -1))
-            for b in range(n_bands)]
+        # Packed pools instead keep a LIFO of free SUPERPAGE ids (group 0,
+        # the trash group, excluded).
+        if self.pages_per_block > 1:
+            self._free = [[]]
+            self._free_sp: list[int] = list(
+                range(num_pages // self.pages_per_block - 1, 0, -1))
+        else:
+            self._free = [
+                list(range((b + 1) * self.band_pages - 1,
+                           b * self.band_pages, -1))
+                for b in range(n_bands)]
+            self._free_sp = []
         # [B, NP] physical page per (slot, logical page); 0 = unallocated
         # (0 is band 0's trash page, never a real mapping).
         self.table = np.zeros((batch, self.pages_per_slot), np.int32)
@@ -67,6 +99,8 @@ class PageAllocator:
 
     @property
     def free_pages(self) -> int:
+        if self.pages_per_block > 1:
+            return len(self._free_sp) * self.pages_per_block
         return sum(len(f) for f in self._free)
 
     def _band_of(self, logical_page: int) -> int:
@@ -75,10 +109,17 @@ class PageAllocator:
     def pages_needed(self, total_tokens: int, ring_pages: int = 0) -> int:
         need = (min(total_tokens, self.pages_per_slot * self.page_size)
                 + self.page_size - 1) // self.page_size
-        return min(need, ring_pages) if ring_pages else need
+        need = min(need, ring_pages) if ring_pages else need
+        if self.pages_per_block > 1:
+            # Whole superpage runs only — the packing invariant's price.
+            b = self.pages_per_block
+            need = -(-need // b) * b
+        return need
 
     def can_admit(self, total_tokens: int, ring_pages: int = 0) -> bool:
         need = self.pages_needed(total_tokens, ring_pages)
+        if self.pages_per_block > 1:
+            return need // self.pages_per_block <= len(self._free_sp)
         if self.n_bands == 1:
             return need <= len(self._free[0])
         return all(
@@ -99,10 +140,23 @@ class PageAllocator:
         if ring_pages and self.n_bands > 1:
             raise ValueError("ring reservation is single-band only "
                              "(SWA × seq is rejected at engine build)")
+        if ring_pages and self.pages_per_block > 1:
+            # Ring rotation remaps one page at a time, which would break
+            # the aligned-run invariant; the engine disables packing on
+            # SWA-ring builds, so this is a misuse guard.
+            raise ValueError("ring reservation is incompatible with "
+                             "superpage packing")
         need = self.pages_needed(total_tokens, ring_pages)
         if not self.can_admit(total_tokens, ring_pages):
             return False
-        pages = [self._free[self._band_of(j)].pop() for j in range(need)]
+        if self.pages_per_block > 1:
+            ppb = self.pages_per_block
+            sps = [self._free_sp.pop() for _ in range(need // ppb)]
+            # Logical group g → superpage sps[g]: pt[slot, g·ppb + i] =
+            # sps[g]·ppb + i, aligned and contiguous per run.
+            pages = [sp * ppb + i for sp in sps for i in range(ppb)]
+        else:
+            pages = [self._free[self._band_of(j)].pop() for j in range(need)]
         self._held[slot] = pages
         self.table[slot, :] = 0
         self.table[slot, :need] = pages
@@ -145,22 +199,44 @@ class PageAllocator:
     def release(self, slot: int) -> None:
         pages = self._held.pop(slot, None)
         if pages:
-            for j, p in enumerate(pages):
-                self._free[self._band_of(j)].append(p)
+            if self.pages_per_block > 1:
+                ppb = self.pages_per_block
+                for sp in dict.fromkeys(p // ppb for p in pages):
+                    self._free_sp.append(sp)
+            else:
+                for j, p in enumerate(pages):
+                    self._free[self._band_of(j)].append(p)
         self._ring_slots.discard(slot)
         self.table[slot, :] = 0
 
     def check_invariants(self) -> None:
         """Test hook: every non-trash page is either free or held by exactly
         one slot; table rows agree with holdings; banded pages stay in
-        their position band."""
+        their position band; packed holdings are aligned whole runs."""
         held = [p for pages in self._held.values() for p in pages]
-        free = [p for f in self._free for p in f]
-        trash = {b * self.band_pages for b in range(self.n_bands)}
+        if self.pages_per_block > 1:
+            ppb = self.pages_per_block
+            free = [sp * ppb + i for sp in self._free_sp for i in range(ppb)]
+            trash = set(range(ppb))          # the whole trash group
+            assert 0 not in self._free_sp, "trash superpage leaked"
+            assert len(self._free_sp) == len(set(self._free_sp)), \
+                "superpage double-freed"
+            for slot, pages in self._held.items():
+                assert len(pages) % ppb == 0, "partial superpage held"
+                for g in range(len(pages) // ppb):
+                    run = pages[g * ppb:(g + 1) * ppb]
+                    assert run[0] % ppb == 0, "unaligned superpage run"
+                    assert run == list(range(run[0], run[0] + ppb)), \
+                        "non-contiguous superpage run"
+        else:
+            free = [p for f in self._free for p in f]
+            trash = {b * self.band_pages for b in range(self.n_bands)}
         assert len(held) == len(set(held)), "page double-held"
         assert not (set(held) & set(free)), "page both free and held"
         assert not (trash & set(held + free)), "trash page leaked"
-        assert len(held) + len(free) == self.num_pages - self.n_bands, \
+        n_reserved = (self.pages_per_block if self.pages_per_block > 1
+                      else self.n_bands)
+        assert len(held) + len(free) == self.num_pages - n_reserved, \
             "page lost"
         for slot, pages in self._held.items():
             row = self.table[slot]

@@ -77,7 +77,7 @@ def self_column_init(q_ref, kn_ref, vn_ref, m_ref, l_ref, acc_ref) -> None:
 
 
 def attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask,
-                 ks_ref=None, vs_ref=None) -> None:
+                 ks_ref=None, vs_ref=None, sub: int = 0) -> None:
     """One online-softmax block update — THE shared compute of every flash
     kernel here and in ops/paged_attention.py (dense/paged × decode/prefill
     × bf16/int8-KV). ``mask(scores)`` applies the caller's visibility rule;
@@ -90,16 +90,22 @@ def attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask,
     the Dh contraction, so scores
     multiply by ``ks`` after the QK dot and probs by ``vs`` before the PV
     dot (after ``l`` accumulates — the softmax denominator is unscaled),
-    and no dequantized [BS, Dh] block is ever built."""
+    and no dequantized [BS, Dh] block is ever built.
+
+    ``sub`` (static) selects the K/V/scale sub-block along the leading
+    block dim: the multi-page paged kernels fetch ``pages_per_block``
+    physical pages in ONE ``(ppb, 1, page, Dh)`` block and attend them
+    per-page (ops/paged_attention.py), so each call here stays the exact
+    per-page update — only the DMA granularity grows."""
     q = q_ref[0, 0].astype(jnp.float32)            # [rows, Dh]
-    k = k_ref[0, 0].astype(jnp.float32)            # [BS, Dh] (bf16 or int8)
-    v = v_ref[0, 0].astype(jnp.float32)
+    k = k_ref[sub, 0].astype(jnp.float32)          # [BS, Dh] (bf16 or int8)
+    v = v_ref[sub, 0].astype(jnp.float32)
     scores = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)        # [rows, BS]
     scores *= q.shape[-1] ** -0.5
     if ks_ref is not None:
-        scores = scores * ks_ref[0, 0]
+        scores = scores * ks_ref[sub, 0]
     scores = mask(scores)
 
     m_prev = m_ref[:, :1]
@@ -107,7 +113,7 @@ def attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask,
     alpha = jnp.exp(m_prev - m_new)
     e = jnp.exp(scores - m_new)                    # [rows, BS]
     l_ref[:, :1] = alpha * l_ref[:, :1] + jnp.sum(e, axis=1, keepdims=True)
-    p = e if vs_ref is None else e * vs_ref[0, 0]
+    p = e if vs_ref is None else e * vs_ref[sub, 0]
     acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
         p, v, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)        # [rows, Dh]

@@ -195,11 +195,12 @@ def paged_insert_all(pool_k, pool_v,
 # ---------------------------------------------------------------------------
 
 def _paged_decode_kernel(pt_ref, nvalid_ref, q_ref, kn_ref, vn_ref,
-                         *refs, page: int, window: int = 0):
+                         *refs, page: int, window: int = 0,
+                         pages_per_block: int = 1):
     k_ref, ks_ref, v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = \
         unpack_kv_refs(refs)
     b = pl.program_id(0)
-    j = pl.program_id(2)
+    j = pl.program_id(2)        # run of `pages_per_block` logical pages
     n_pb = pl.num_programs(2)
 
     @pl.when(j == 0)
@@ -214,21 +215,28 @@ def _paged_decode_kernel(pt_ref, nvalid_ref, q_ref, kn_ref, vn_ref,
     # repeat an in-window physical page) — a windowed paged decode reads
     # O(window) pages, not O(context): SWA's whole point, compounded.
     w0 = jnp.maximum(n_valid - (window - 1), 0) if window else 0
-    live = j * page < n_valid
-    if window:
-        live = live & ((j + 1) * page > w0)
+    # Per-page attends over the block's sub-pages, unrolled
+    # (pages_per_block is compile-time): the SAME online-softmax updates
+    # in the SAME order as the per-page kernel, so any pages_per_block is
+    # bit-for-bit with 1 — only the HBM→VMEM DMA granularity changes
+    # (one (ppb·page, Dh) copy instead of ppb (page, Dh) copies).
+    for i in range(pages_per_block):
+        lp = j * pages_per_block + i                   # logical page
+        live = lp * page < n_valid
+        if window:
+            live = live & ((lp + 1) * page > w0)
 
-    @pl.when(live)
-    def _block():
-        def mask(scores):
-            pos = j * page + jax.lax.broadcasted_iota(
-                jnp.int32, scores.shape, 1)
-            ok = pos < n_valid
-            if window:
-                ok = ok & (pos >= w0)
-            return jnp.where(ok, scores, NEG_INF)
-        attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask,
-                     ks_ref, vs_ref)
+        @pl.when(live)
+        def _block(i=i, lp=lp):
+            def mask(scores):
+                pos = lp * page + jax.lax.broadcasted_iota(
+                    jnp.int32, scores.shape, 1)
+                ok = pos < n_valid
+                if window:
+                    ok = ok & (pos >= w0)
+                return jnp.where(ok, scores, NEG_INF)
+            attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask,
+                         ks_ref, vs_ref, sub=i)
 
     @pl.when(j == n_pb - 1)
     def _out():
@@ -236,11 +244,30 @@ def _paged_decode_kernel(pt_ref, nvalid_ref, q_ref, kn_ref, vn_ref,
         o_ref[0, 0] = (acc_ref[:] / l).astype(o_ref.dtype)
 
 
+def _check_pages_per_block(ppb: int, NP: int, P: int) -> None:
+    """Static geometry gate for the multi-page kernels: the table width and
+    the pool's page count must both split into whole runs. The SEMANTIC
+    requirement — every aligned group of ``ppb`` logical pages maps to an
+    aligned contiguous run of physical pages (``pt[b, g·ppb+i] ==
+    pt[b, g·ppb] + i`` with ``pt[b, g·ppb] % ppb == 0``) — is the
+    caller's promise; the engine's superpage-packing allocator
+    (engine/paged.py ``pages_per_block``) is the one producer that
+    guarantees it, and the engine falls back to per-page blocks whenever
+    it can't (SWA ring, seq banding, non-divisible geometry)."""
+    if ppb < 1:
+        raise ValueError(f"pages_per_block must be >= 1, got {ppb}")
+    if ppb > 1 and (NP % ppb or P % ppb):
+        raise ValueError(
+            f"pages_per_block={ppb} needs the page-table width ({NP}) and "
+            f"the pool's page count ({P}) divisible by it")
+
+
 def paged_decode_attention(q: jax.Array, k_new: jax.Array,
                            v_new: jax.Array, k_pages, v_pages,
                            page_table: jax.Array,
                            n_stale: jax.Array, *,
                            window: int = 0,
+                           pages_per_block: int = 1,
                            interpret: bool | None = None) -> jax.Array:
     """Ragged single-token attention over the STALE page pool plus the new
     token (self column folded into the online-softmax init).
@@ -250,44 +277,61 @@ def paged_decode_attention(q: jax.Array, k_new: jax.Array,
     page_table: [B, NP]; n_stale: [B] int32 (the query's position; 0 for a
     fresh slot). ``window``: sliding-window bound (mistral family; 0 =
     full) — pages wholly out of window skip compute and DMA, so a
-    windowed decode reads O(window) pages. Returns [B, H*Dh].
+    windowed decode reads O(window) pages. ``pages_per_block``: fetch a
+    compile-time run of contiguous logical pages per grid step — the
+    K/V block grows to ``(ppb, 1, page, Dh)`` (one pages_per_block×
+    larger HBM→VMEM DMA) and the grid's page dim shrinks by the same
+    factor; requires a PACKED table (see :func:`_check_pages_per_block`).
+    Numerics are bit-for-bit identical across pages_per_block values
+    (per-page attends, unrolled in order). Returns [B, H*Dh].
     """
     B, H, Dh = q.shape
     quant = isinstance(k_pages, dict)
     kq = k_pages["q"] if quant else k_pages
     KV, page = kq.shape[1], kq.shape[2]
     NP = page_table.shape[1]
+    ppb = pages_per_block
+    _check_pages_per_block(ppb, NP, kq.shape[0])
+    bs = ppb * page                      # tokens per grid step
     G = H // KV
     qg = q.reshape(B, KV, G, Dh)
-    grid = (B, KV, NP)
+    grid = (B, KV, NP // ppb)
 
     def _live_range(nv_b):
-        """(first, last) live logical page — out-of-range iterations
-        re-reference a live physical page so their DMA is elided
-        (pl.when skips their compute); flash_attention._live_range is
-        the dense twin."""
-        last = jnp.maximum((nv_b + page - 1) // page - 1, 0)
+        """(first, last) live BLOCK (run of ppb logical pages) —
+        out-of-range iterations re-reference a live block so their DMA is
+        elided (pl.when skips their compute); flash_attention._live_range
+        is the dense twin."""
+        last = jnp.maximum((nv_b + bs - 1) // bs - 1, 0)
         if window:
             first = jnp.minimum(
-                jnp.maximum(nv_b - (window - 1), 0) // page, last)
+                jnp.maximum(nv_b - (window - 1), 0) // bs, last)
         else:
             first = 0
         return first, last
 
+    def _phys_block(pt, b, g):
+        # Gather-free: ONE table lookup per grid step. The packed-table
+        # promise makes the run's first physical page ppb-aligned, so its
+        # superpage id IS the block index along the pool's page dim
+        # (block size ppb ⇒ element offset sp·ppb).
+        p0 = pt[b, g * ppb]
+        return p0 // ppb if ppb > 1 else p0
+
     def kv_index(b, h, j, pt, nv):
         first, last = _live_range(nv[b])
-        return pt[b, jnp.clip(j, first, last)], h, 0, 0
+        return _phys_block(pt, b, jnp.clip(j, first, last)), h, 0, 0
 
     def scale_index(b, h, j, pt, nv):
         first, last = _live_range(nv[b])
-        return pt[b, jnp.clip(j, first, last)], h, 0, 0
+        return _phys_block(pt, b, jnp.clip(j, first, last)), h, 0, 0
 
     # Scales are STORED rank-4 [P, KV, 1, page] so the block's trailing
     # dims are (1, page) — legal under the TPU (8, 128) tiling rule for
     # any KV (see flash_attention.attend_block) — with no per-call
     # relayout of the pool-sized scale tensor.
-    kv_spec = pl.BlockSpec((1, 1, page, Dh), kv_index)
-    s_spec = pl.BlockSpec((1, 1, 1, page), scale_index)
+    kv_spec = pl.BlockSpec((ppb, 1, page, Dh), kv_index)
+    s_spec = pl.BlockSpec((ppb, 1, 1, page), scale_index)
     if quant:
         kv_operands = (k_pages["q"], k_pages["s"],
                        v_pages["q"], v_pages["s"])
@@ -297,7 +341,8 @@ def paged_decode_attention(q: jax.Array, k_new: jax.Array,
         kv_specs = [kv_spec, kv_spec]
 
     out = pl.pallas_call(
-        functools.partial(_paged_decode_kernel, page=page, window=window),
+        functools.partial(_paged_decode_kernel, page=page, window=window,
+                          pages_per_block=ppb),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
@@ -330,12 +375,13 @@ def paged_decode_attention(q: jax.Array, k_new: jax.Array,
 # ---------------------------------------------------------------------------
 
 def _paged_prefill_kernel(pt_ref, start_ref, q_ref, *refs,
-                          block_t: int, page: int, window: int = 0):
+                          block_t: int, page: int, window: int = 0,
+                          pages_per_block: int = 1):
     k_ref, ks_ref, v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = \
         unpack_kv_refs(refs)
     b = pl.program_id(0)
     t = pl.program_id(2)
-    j = pl.program_id(3)
+    j = pl.program_id(3)        # run of `pages_per_block` logical pages
     n_pb = pl.num_programs(3)
 
     @pl.when(j == 0)
@@ -351,24 +397,29 @@ def _paged_prefill_kernel(pt_ref, start_ref, q_ref, *refs,
     # Causal upper bound; with a sliding window also a lower bound — a
     # page is dead unless its last key position is within `window` of the
     # block's FIRST query (flash_attention._chunk_kernel is the dense
-    # twin). Dead pages skip compute and DMA (index-map clamp).
-    live = j * page <= last_q_pos
-    if window:
-        live = live & ((j + 1) * page - 1 > first_q_pos - window)
+    # twin). Dead pages skip compute and DMA (index-map clamp). Per-page
+    # attends unrolled over the block's sub-pages keep any
+    # pages_per_block bit-for-bit with the per-page kernel (see
+    # _paged_decode_kernel).
+    for i in range(pages_per_block):
+        lp = j * pages_per_block + i                   # logical page
+        live = lp * page <= last_q_pos
+        if window:
+            live = live & ((lp + 1) * page - 1 > first_q_pos - window)
 
-    @pl.when(live)
-    def _block():
-        def mask(scores):
-            q_pos = first_q_pos + jax.lax.broadcasted_iota(
-                jnp.int32, scores.shape, 0)
-            s_pos = j * page + jax.lax.broadcasted_iota(
-                jnp.int32, scores.shape, 1)
-            ok = s_pos <= q_pos
-            if window:
-                ok = ok & (s_pos > q_pos - window)
-            return jnp.where(ok, scores, NEG_INF)
-        attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask,
-                     ks_ref, vs_ref)
+        @pl.when(live)
+        def _block(i=i, lp=lp):
+            def mask(scores):
+                q_pos = first_q_pos + jax.lax.broadcasted_iota(
+                    jnp.int32, scores.shape, 0)
+                s_pos = lp * page + jax.lax.broadcasted_iota(
+                    jnp.int32, scores.shape, 1)
+                ok = s_pos <= q_pos
+                if window:
+                    ok = ok & (s_pos > q_pos - window)
+                return jnp.where(ok, scores, NEG_INF)
+            attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask,
+                         ks_ref, vs_ref, sub=i)
 
     @pl.when(j == n_pb - 1)
     def _out():
@@ -381,6 +432,7 @@ def paged_prefill_attention(q: jax.Array, k_pages, v_pages,
                             page_table: jax.Array,
                             start: jax.Array, *, block_t: int = 128,
                             window: int = 0,
+                            pages_per_block: int = 1,
                             interpret: bool | None = None) -> jax.Array:
     """Causal chunk attention over the page pool (keys already inserted).
 
@@ -388,6 +440,9 @@ def paged_prefill_attention(q: jax.Array, k_pages, v_pages,
     k_pages/v_pages: [P, KV, page, Dh] or the int8 ``{"q","s"}`` dicts;
     page_table: [B, NP]; start: [B]. ``window``: sliding-window bound
     (0 = full causal) — out-of-window pages skip compute and DMA.
+    ``pages_per_block``: run of contiguous logical pages fetched per
+    inner-loop step (same packed-table contract and bit-for-bit
+    guarantee as :func:`paged_decode_attention`).
     Returns [B, T, H*Dh].
     """
     B, T, H, Dh = q.shape
@@ -395,35 +450,43 @@ def paged_prefill_attention(q: jax.Array, k_pages, v_pages,
     kq = k_pages["q"] if quant else k_pages
     KV, page = kq.shape[1], kq.shape[2]
     NP = page_table.shape[1]
+    ppb = pages_per_block
+    _check_pages_per_block(ppb, NP, kq.shape[0])
+    bs = ppb * page
     G = H // KV
     block_t = min(block_t, T)
     if T % block_t:
         raise ValueError(f"T={T} not a multiple of block_t={block_t}")
     qh = q.transpose(0, 2, 1, 3)
-    grid = (B, H, T // block_t, NP)
+    grid = (B, H, T // block_t, NP // ppb)
 
     def _live_range(st_b, t):
         first_q = st_b + t * block_t
-        last = (first_q + block_t - 1) // page
+        last = (first_q + block_t - 1) // bs
         if window:
             first = jnp.minimum(
-                jnp.maximum(first_q - (window - 1), 0) // page, last)
+                jnp.maximum(first_q - (window - 1), 0) // bs, last)
         else:
             first = 0
         return first, last
 
+    def _phys_block(pt, b, g):
+        # Gather-free superpage lookup — see paged_decode_attention.
+        p0 = pt[b, g * ppb]
+        return p0 // ppb if ppb > 1 else p0
+
     def kv_index(b, h, t, j, pt, st):
         first, last = _live_range(st[b], t)
-        return pt[b, jnp.clip(j, first, last)], h // G, 0, 0
+        return _phys_block(pt, b, jnp.clip(j, first, last)), h // G, 0, 0
 
     def scale_index(b, h, t, j, pt, st):
         first, last = _live_range(st[b], t)
-        return pt[b, jnp.clip(j, first, last)], h // G, 0, 0
+        return _phys_block(pt, b, jnp.clip(j, first, last)), h // G, 0, 0
 
     # Stored rank-4 [P, KV, 1, page] scale layout — see
     # paged_decode_attention.
-    kv_spec = pl.BlockSpec((1, 1, page, Dh), kv_index)
-    s_spec = pl.BlockSpec((1, 1, 1, page), scale_index)
+    kv_spec = pl.BlockSpec((ppb, 1, page, Dh), kv_index)
+    s_spec = pl.BlockSpec((ppb, 1, 1, page), scale_index)
     if quant:
         kv_operands = (k_pages["q"], k_pages["s"],
                        v_pages["q"], v_pages["s"])
@@ -434,7 +497,7 @@ def paged_prefill_attention(q: jax.Array, k_pages, v_pages,
 
     out = pl.pallas_call(
         functools.partial(_paged_prefill_kernel, block_t=block_t, page=page,
-                          window=window),
+                          window=window, pages_per_block=ppb),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=grid,
@@ -523,7 +586,8 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
                             impl: str = "pallas",
                             block_t: int | None = None,
                             interpret: bool | None = None,
-                            mesh=None, window: int = 0):
+                            mesh=None, window: int = 0,
+                            pages_per_block: int = 1):
     """Build an ``attention_fn`` (llama.forward contract) over a paged cache.
 
     Constructed INSIDE the engine's jitted step function, closing over the
@@ -531,6 +595,9 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
     ``layer_k``/``layer_v`` are the per-layer page pools from the scanned
     ``PagedKVCache``. ``impl``: "pallas" (kernels) or "reference" (gather +
     dense jnp — exact but materializes [B, S]; CPU tests).
+    ``pages_per_block``: multi-page kernel blocking (pallas impl only;
+    the reference path gathers densely and ignores it) — requires the
+    engine's superpage-packed allocator behind the table.
 
     With a multi-device ``mesh`` the kernels run under ``shard_map`` manual
     over the ``model`` axis — pages are sharded on their KV-head dim, the
@@ -574,7 +641,7 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
             f = shard_map(
                 lambda q_, k_, v_, pt_, st_: paged_prefill_attention(
                     q_, k_, v_, pt_, st_, block_t=bt, window=window,
-                    interpret=interpret),
+                    pages_per_block=pages_per_block, interpret=interpret),
                 mesh=mesh,
                 in_specs=(P(None, None, "model", None), pool, pool,
                           P(None, None), P(None)),
@@ -584,7 +651,8 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
         else:
             out = paged_prefill_attention(
                 q, layer_k, layer_v, page_table, lengths,
-                block_t=bt, window=window, interpret=interpret)
+                block_t=bt, window=window,
+                pages_per_block=pages_per_block, interpret=interpret)
         return out, layer_k, layer_v
 
     def decode(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
@@ -607,7 +675,7 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
             f = shard_map(
                 lambda q_, kn_, vn_, k_, v_, pt_, nv_: paged_decode_attention(
                     q_, kn_, vn_, k_, v_, pt_, nv_, window=window,
-                    interpret=interpret),
+                    pages_per_block=pages_per_block, interpret=interpret),
                 mesh=mesh,
                 in_specs=(P(None, "model", None), P(None, "model", None),
                           P(None, "model", None), pool, pool,
@@ -619,7 +687,8 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
         else:
             out = paged_decode_attention(
                 q[:, 0], k_new[:, 0], v_new[:, 0], layer_k, layer_v,
-                page_table, n_stale, window=window, interpret=interpret)
+                page_table, n_stale, window=window,
+                pages_per_block=pages_per_block, interpret=interpret)
         return out[:, None, :]
 
     def insert_all(pool_k, pool_v, k_news, v_news, lengths, active):

@@ -116,6 +116,7 @@ def build_app(settings: Settings | None = None,
 
     # Observability: engine stats + on-demand device trace capture
     app.router.add_get("/v1/api/engine-stats", profiler_api.get_engine_stats)
+    app.router.add_get("/v1/api/roofline", profiler_api.get_roofline)
     app.router.add_post("/v1/api/profiler/trace", profiler_api.capture_trace)
 
     if STATIC_DIR.exists():

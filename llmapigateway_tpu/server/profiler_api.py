@@ -11,6 +11,11 @@ Endpoints (wired in server/app.py):
 
 * ``GET  /v1/api/engine-stats`` — per-local-provider engine stats + device
   inventory. Cheap; safe to poll.
+* ``GET  /v1/api/roofline`` — the roofline slice of those stats (achieved
+  GB/s from the engine's bytes-touched model × measured step time, burst
+  depth / prefill-aware clamp counters, queue wait), one row per local
+  engine — what the bench ladder and the stats UI read to track the
+  0.478→1.0 HBM-roofline trajectory (ISSUE 2). Cheap; safe to poll.
 * ``POST /v1/api/profiler/trace?duration_ms=N`` — capture a profiler trace
   of the next N ms of live traffic into ``<logs_dir>/profiles/<name>``;
   returns the directory path. One capture at a time.
@@ -99,6 +104,29 @@ async def get_engine_stats(request: web.Request) -> web.Response:
         "devices": _dev_state["devices"],
         "device_status": _dev_state["status"],
     })
+
+
+# The roofline slice of an engine's stats() dict: bandwidth model,
+# step-time gauge, burst-depth controller, and admission-wait counters.
+ROOFLINE_KEYS = (
+    "achieved_gbps", "roofline_fraction", "hbm_bytes_per_step",
+    "decode_ms_per_step", "decode_tok_s",
+    "burst_depth_last", "burst_busy_clamps", "burst_depth_hist",
+    "burst_step_ms_fit", "burst_fixed_cost_ms", "burst_walls_ms",
+    "queue_wait_ms_ema", "queue_wait_ms_max", "queue_waits",
+    "running", "queued", "pages_per_block",
+)
+
+
+async def get_roofline(request: web.Request) -> web.Response:
+    """Per-engine roofline/scheduler counters — stats() filtered to the
+    fields an operator (or the bench ladder) plots over time."""
+    gw = request.app["gateway"]
+    engines = {}
+    for name, eng in _local_engines(gw):
+        s = eng.stats()
+        engines[name] = {k: s[k] for k in ROOFLINE_KEYS if k in s}
+    return web.json_response({"engines": engines})
 
 
 async def capture_trace(request: web.Request) -> web.Response:

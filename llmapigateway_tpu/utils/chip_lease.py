@@ -1,0 +1,115 @@
+"""Advisory flock-based chip lease shared by bench runs and watcher probes.
+
+A TPU chip admits ONE process at a time: any ``jax.devices()`` call —
+including a builder-side tunnel-watcher "is the chip alive?" probe — holds
+the runtime until the process exits, and a probe that overlaps the
+driver's bench turns the whole scoreboard into an rc=2 empty JSON (the
+round-5 failure: ``BENCH_r05.json``'s "candidate holders" list was the
+builder's own watch-script probes). The fix is a single advisory lock
+file every chip user takes FIRST:
+
+* ``bench.py`` takes the lease with a bounded wait before its backend
+  probe and holds it for the whole run — a probe can delay the bench a
+  few seconds, never kill it.
+* Watcher probes take it NON-BLOCKING (``timeout_s=0``) and simply skip
+  the probe cycle when the bench holds it:
+
+      python -m llmapigateway_tpu.utils.chip_lease --timeout 0 -- \
+          python -c "import jax; jax.devices()"
+
+The lock is ``flock(2)`` on ``/tmp/tpu_chip.lock``: per open-file-
+description (two opens conflict even in one process), released by the
+kernel on ANY process exit — a SIGKILLed bench can never wedge the chip
+behind a stale lockfile the way a pid-file scheme would.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import time
+
+LOCK_PATH = "/tmp/tpu_chip.lock"
+
+
+def _read_holder(path: str) -> str:
+    try:
+        with open(path) as f:
+            return f.read(200).strip()
+    except OSError:
+        return ""
+
+
+@contextlib.contextmanager
+def chip_lease(path: str = LOCK_PATH, timeout_s: float = 0.0,
+               poll_s: float = 0.5, label: str = ""):
+    """Hold the exclusive chip lease for the duration of the ``with``.
+
+    ``timeout_s=0`` is a non-blocking try. Raises ``TimeoutError`` (with
+    the current holder's label, if it wrote one) when the lease can't be
+    taken in time. The holder label (pid + argv by default) is written
+    into the lock file purely for diagnostics."""
+    import fcntl
+    fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o666)
+    t0 = time.monotonic()
+    try:
+        while True:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                break
+            except OSError:
+                if time.monotonic() - t0 >= timeout_s:
+                    holder = _read_holder(path)
+                    raise TimeoutError(
+                        f"chip lease {path} held"
+                        + (f" by [{holder}]" if holder else "")
+                        + f" (waited {time.monotonic() - t0:.1f}s)"
+                    ) from None
+                time.sleep(poll_s)
+        me = label or f"pid {os.getpid()}: {' '.join(sys.argv)[:120]}"
+        with contextlib.suppress(OSError):
+            os.ftruncate(fd, 0)
+            os.pwrite(fd, me.encode(), 0)
+        yield
+    finally:
+        with contextlib.suppress(OSError):
+            os.ftruncate(fd, 0)
+            fcntl.flock(fd, fcntl.LOCK_UN)
+        os.close(fd)
+
+
+def main(argv: list[str] | None = None) -> int:
+    """CLI wrapper: run a command under the lease, or report lease state.
+
+    ``... chip_lease [--timeout S] [--path P] -- CMD ARGS...`` runs CMD
+    holding the lease and propagates its exit code; 75 (EX_TEMPFAIL) when
+    the lease can't be taken — the watcher's cue to skip this cycle.
+    With no command, prints ``free`` / ``held [holder]`` and exits 0/1.
+    """
+    import argparse
+    import subprocess
+    argv = sys.argv[1:] if argv is None else argv
+    cmd: list[str] = []
+    if "--" in argv:
+        i = argv.index("--")
+        argv, cmd = argv[:i], argv[i + 1:]
+    ap = argparse.ArgumentParser(prog="chip_lease")
+    ap.add_argument("--timeout", type=float, default=0.0)
+    ap.add_argument("--path", default=LOCK_PATH)
+    args = ap.parse_args(argv)
+    try:
+        with chip_lease(args.path, timeout_s=args.timeout):
+            if not cmd:
+                print("free")
+                return 0
+            return subprocess.run(cmd).returncode
+    except TimeoutError as e:
+        if not cmd:
+            print(f"held [{_read_holder(args.path)}]")
+            return 1
+        print(f"chip_lease: {e}", file=sys.stderr)
+        return 75
+
+
+if __name__ == "__main__":
+    sys.exit(main())

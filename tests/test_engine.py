@@ -602,3 +602,97 @@ def test_no_ttft_target_keeps_fixed_depths():
     eng._burst_walls = {8: 400.0}        # samples present, target unset
     assert eng._burst_depth(busy=False) == 8
     assert eng._burst_depth(busy=True) == 2
+
+
+def test_prefill_aware_clamp_caps_busy_depth():
+    """ISSUE 2 tentpole (scheduler leg): while an admission waits, a busy
+    burst may spend at most a QUARTER of the TTFT budget — at target
+    scale (23 ms/step, r5b) the configured busy depth alone holds every
+    prefill chunk behind a ~100-400 ms scan, compounding into the
+    measured 742.8 ms p50. The clamp snaps below ``decode_burst_busy``
+    (to the synchronous burst=1 path if nothing compiled fits) and
+    leaves idle-queue depth untouched — fixed-burst TTFT without the
+    fixed-burst throughput tax."""
+    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+                            max_seq_len=64, prefill_chunk=16,
+                            dtype="float32", decode_burst=32,
+                            decode_burst_busy=16, ttft_target_ms=100.0)
+    eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
+    # No step-time sample yet: busy runs the configured busy depth.
+    assert eng._burst_depth(busy=True) == 16
+    assert eng._busy_clamps == 0
+    # Fitted 2 ms/step: busy budget 25 ms -> cap 12.5 -> snaps to 8.
+    eng._burst_walls = {32: 96.0, 16: 64.0}
+    assert eng._burst_depth(busy=True) == 8
+    assert eng._busy_clamps == 1
+    # Idle depth is NOT reduced by the busy clamp (cap 50/2 = 25 -> 24).
+    assert eng._burst_depth(busy=False) == 24
+    # Pathologically slow steps: nothing compiled fits a quarter budget
+    # -> burst=1 (synchronous path), still correct. (Drop the persisted
+    # slope fit — this models a cold engine whose only evidence is the
+    # one slow amortized wall.)
+    eng._burst_walls = {32: 3200.0}
+    eng._fit_slope = None
+    assert eng._burst_depth(busy=True) == 1
+    # Fast steps: the configured busy depth already fits -> unclamped.
+    eng._burst_walls = {32: 32.0, 16: 16.0}   # 1 ms/step, cap 25
+    clamps = eng._busy_clamps
+    assert eng._burst_depth(busy=True) == 16
+    assert eng._busy_clamps == clamps
+    # Without a target the busy depth is never clamped (legacy behavior).
+    eng.ttft_target_ms = 0.0
+    eng._burst_walls = {32: 3200.0}
+    assert eng._burst_depth(busy=True) == 16
+    # The chosen depth and clamp count surface in stats.
+    s = eng.stats()
+    assert s["burst_depth_last"] == 16
+    assert s["burst_busy_clamps"] >= 1
+
+
+async def test_queue_wait_and_clamp_surface_in_stats_under_load():
+    """Engine-level scheduler leg of the acceptance: with a TTFT target
+    and slow measured steps, a probe admitted against a saturated batch
+    rides clamped (burst=1) interleaves — queue wait stays bounded and
+    the stats counters (queue_wait, busy clamps, burst depth) read back
+    end-to-end."""
+    from llmapigateway_tpu.engine.engine import FaultPlan
+
+    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+                            max_seq_len=128, prefill_chunk=16,
+                            dtype="float32", decode_burst=8,
+                            decode_burst_busy=8, ttft_target_ms=100.0)
+    eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
+    try:
+        plan = FaultPlan()
+        eng.fault_plan = plan
+        bg = GenRequest(prompt_ids=list(range(2, 18)), max_tokens=100)
+        await eng.submit(bg)
+        while bg.t_first_token is None:
+            await asyncio.sleep(0.005)
+        # Pretend the model measured SLOW (100 ms/step): every busy
+        # burst must clamp below the configured busy depth of 8. The
+        # probe's prompt spans THREE prefill chunks so clamped decode
+        # rounds actually interleave mid-prefill (a one-chunk prompt
+        # admits and finishes inside a single scheduler step).
+        eng._burst_walls = {8: 800.0}
+        eng._burst_wall_stamp = {8: eng._burst_wall_n}
+        eng._fit_slope = None
+        probe = GenRequest(prompt_ids=list(range(3, 43)), max_tokens=2)
+        bursts_at_submit = plan.decode_calls
+        await eng.submit(probe)
+        while probe.t_first_token is None and probe.finish_reason is None:
+            await asyncio.sleep(0.005)
+        assert probe.t_first_token is not None
+        assert bg.finish_reason is None          # saturation was real
+        # Bounded interleave: the in-flight burst plus clamped rounds.
+        assert plan.decode_calls - bursts_at_submit <= 3, \
+            f"probe waited {plan.decode_calls - bursts_at_submit} bursts"
+        s = eng.stats()
+        assert s["burst_busy_clamps"] >= 1
+        assert s["queue_waits"] >= 2             # bg + probe admissions
+        assert s["queue_wait_ms_max"] >= s["queue_wait_ms_ema"] > 0
+        bg.cancelled = True
+        async for _ in eng.stream(probe):
+            pass
+    finally:
+        await eng.stop()

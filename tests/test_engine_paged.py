@@ -269,3 +269,63 @@ async def test_swa_ring_serves_full_context_from_small_pool(stop_engine):
     finally:
         await dense.stop()
         await paged.stop()
+
+
+async def test_multipage_engine_matches_per_page_tokens():
+    """kv_pages_per_block=2 serves EXACTLY the tokens of the per-page
+    engine through the real scheduler on the interpret-mode Pallas
+    kernels — the engine-level face of the kernel parity matrix (the
+    full ppb 1/2/4 × quant × window matrix runs kernel-level in
+    tests/test_ops_paged_multipage.py; numerics are
+    pages_per_block-invariant by construction)."""
+    prompts = ("hello world", "a much longer prompt " * 4)
+
+    async def tokens(ppb):
+        eng = _mk_engine(max_batch_size=2, kv_pages_per_block=ppb,
+                         attention="pallas")
+        try:
+            assert eng.kv_ppb == ppb
+            out = []
+            for p in prompts:
+                out.append((await _generate(eng, p, max_tokens=6)).generated)
+            return out
+        finally:
+            await eng.stop()
+
+    assert await tokens(1) == await tokens(2)
+
+
+def test_multipage_fallback_when_geometry_cannot_pack():
+    """Non-divisible page geometry falls back to per-page blocks (warning,
+    not a broken engine): S=128/page=16 gives 8 pages per slot — 3 does
+    not divide it."""
+    eng = _mk_engine(kv_pages_per_block=3)
+    try:
+        assert eng.kv_ppb == 1
+        assert eng.allocator.pages_per_block == 1
+    finally:
+        eng._stopped = True
+
+    # Divisible geometry engages packing end to end.
+    eng = _mk_engine(kv_pages_per_block=2)
+    try:
+        assert eng.kv_ppb == 2
+        assert eng.allocator.pages_per_block == 2
+        assert eng.stats()["pages_per_block"] == 2
+    finally:
+        eng._stopped = True
+
+
+async def test_multipage_admission_backpressure_accounts_fragmentation():
+    """Superpage rounding is reflected in admission accounting: reserving
+    rounds UP to whole runs, so free_pages drops in run multiples and
+    releases restore them exactly."""
+    eng = _mk_engine(max_batch_size=2, kv_pages_per_block=4)
+    try:
+        free0 = eng.allocator.free_pages
+        req = await _generate(eng, "short", max_tokens=4)
+        assert req.finish_reason is not None
+        eng.allocator.check_invariants()
+        assert eng.allocator.free_pages == free0     # released on finish
+    finally:
+        await eng.stop()

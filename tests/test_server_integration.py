@@ -363,3 +363,41 @@ async def test_cors_specific_origin_sets_vary():
         assert resp.headers["Vary"] == "Origin"
     finally:
         await client.close()
+
+
+async def test_roofline_endpoint(tmp_path, monkeypatch):
+    """/v1/api/roofline: proxy-only deployments report no engines; with a
+    local engine, the endpoint serves exactly the roofline slice of its
+    stats (ISSUE 2 — the number the stats UI and bench ladder poll)."""
+    async with Gateway(tmp_path) as g:
+        resp = await g.client.get("/v1/api/roofline")
+        assert resp.status == 200
+        assert (await resp.json())["engines"] == {}
+
+        class FakeEngine:
+            def stats(self):
+                return {
+                    # The r5b-measured operating point, as stats() shapes it.
+                    "achieved_gbps": 392.1, "roofline_fraction": 0.478,
+                    "hbm_bytes_per_step": 9_018_000_000,
+                    "decode_ms_per_step": 23.0, "decode_tok_s": 1391.1,
+                    "burst_depth_last": 16, "burst_busy_clamps": 3,
+                    "queue_wait_ms_ema": 12.5, "queue_wait_ms_max": 80.0,
+                    "queue_waits": 7, "running": 2, "queued": 0,
+                    # Non-roofline stats fields must be filtered out.
+                    "kv_layout": "paged", "free_pages": 10,
+                }
+
+        class FakeProv:
+            engine = FakeEngine()
+
+        monkeypatch.setattr(g.gw.registry, "instantiated",
+                            lambda: [("local_tpu", FakeProv())])
+        resp = await g.client.get("/v1/api/roofline")
+        assert resp.status == 200
+        row = (await resp.json())["engines"]["local_tpu"]
+        assert row["achieved_gbps"] == 392.1
+        assert row["roofline_fraction"] == 0.478
+        assert row["burst_busy_clamps"] == 3
+        assert row["queue_wait_ms_max"] == 80.0
+        assert "kv_layout" not in row and "free_pages" not in row

@@ -81,16 +81,19 @@ def test_dense_prefill_lowers_for_tpu(quant, window):
 
 @pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8kv"])
 @pytest.mark.parametrize("window", [0, 96], ids=["full", "windowed"])
-def test_paged_decode_lowers_for_tpu(quant, window):
+@pytest.mark.parametrize("ppb", [1, 2], ids=["ppb1", "ppb2"])
+def test_paged_decode_lowers_for_tpu(quant, window, ppb):
     key = jax.random.PRNGKey(0)
     q = jax.random.normal(key, (B, H, Dh), jnp.bfloat16)
     kn = jax.random.normal(key, (B, KV, Dh), jnp.bfloat16)
     vn = jax.random.normal(key, (B, KV, Dh), jnp.bfloat16)
     pk, pv = _paged_kv(quant)
-    ptab = jnp.array([[1, 2], [3, 4]], jnp.int32)
+    # Packed for ppb=2: each slot's 2-page group is an aligned run.
+    ptab = jnp.array([[2, 3], [4, 5]], jnp.int32)
     ns = jnp.array([100, 0], jnp.int32)
     _lower(lambda *a: pa.paged_decode_attention(
-        *a, window=window, interpret=False), q, kn, vn, pk, pv, ptab, ns)
+        *a, window=window, pages_per_block=ppb, interpret=False),
+        q, kn, vn, pk, pv, ptab, ns)
 
 
 @pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8kv"])
@@ -128,11 +131,13 @@ def test_tp_sharded_decode_wrapper_lowers_for_tpu(quant, window):
 
 @pytest.mark.parametrize("quant", [False, True], ids=["bf16", "int8kv"])
 @pytest.mark.parametrize("window", [0, 96], ids=["full", "windowed"])
-def test_paged_prefill_lowers_for_tpu(quant, window):
+@pytest.mark.parametrize("ppb", [1, 2], ids=["ppb1", "ppb2"])
+def test_paged_prefill_lowers_for_tpu(quant, window, ppb):
     key = jax.random.PRNGKey(0)
     qp = jax.random.normal(key, (B, T, H, Dh), jnp.bfloat16)
     pk, pv = _paged_kv(quant)
-    ptab = jnp.array([[1, 2], [3, 4]], jnp.int32)
+    ptab = jnp.array([[2, 3], [4, 5]], jnp.int32)
     st = jnp.array([0, 64], jnp.int32)
     _lower(lambda *a: pa.paged_prefill_attention(
-        *a, window=window, interpret=False), qp, pk, pv, ptab, st)
+        *a, window=window, pages_per_block=ppb, interpret=False),
+        qp, pk, pv, ptab, st)

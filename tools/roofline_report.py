@@ -1,0 +1,120 @@
+"""Per-rung achieved-GB/s table from bench ladder JSON (ISSUE 2 tooling).
+
+The bench emits ONE JSON line per run (``BENCH_SELF_*_ladder.json`` /
+``BENCH_r0N.json``) whose ``extra`` tree nests rung dicts, each carrying
+some of ``tok_s`` / ``ms_per_decode_step`` / ``hbm_gbps`` /
+``roofline_fraction`` (bench-side accounting) and, since 0.15,
+``engine_achieved_gbps`` (the engine's own stats() gauge). This tool
+flattens that tree into one row per rung so the 0.478→1.0 roofline
+trajectory is a table you can diff across rounds instead of a JSON blob
+you grep:
+
+    python tools/roofline_report.py BENCH_SELF_r5_ladder.json
+    python tools/roofline_report.py --json BENCH_*.json   # machine-readable
+
+Rows are discovered structurally (any dict owning a bandwidth or
+step-time field), so new bench rungs appear without editing this file.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+# A dict is a "rung" when it carries any of these measurements.
+RUNG_FIELDS = ("hbm_gbps", "engine_achieved_gbps", "ms_per_decode_step",
+               "tok_s")
+COLUMNS = ("tok_s", "ms_per_decode_step", "hbm_gbps", "roofline_fraction",
+           "engine_achieved_gbps", "engine_roofline_fraction")
+
+
+def find_rungs(node, path="") -> list[tuple[str, dict]]:
+    """Depth-first walk: every dict carrying a measurement field becomes a
+    rung row named by its JSON path (the top level reports as 'headline')."""
+    rows = []
+    if isinstance(node, dict):
+        if any(k in node for k in RUNG_FIELDS):
+            rows.append((path or "headline", node))
+        for key, val in node.items():
+            rows.extend(find_rungs(val, f"{path}.{key}" if path else key))
+    return rows
+
+
+def load_result(path: Path) -> dict:
+    """A ladder file is one JSON line (possibly preceded by log noise —
+    take the last parseable line, same contract the driver applies)."""
+    last_err = None
+    for line in reversed(path.read_text().strip().splitlines()):
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            return json.loads(line)
+        except json.JSONDecodeError as e:
+            last_err = e
+    raise ValueError(f"{path}: no parseable JSON line ({last_err})")
+
+
+def report(paths: list[Path], peak_gbps: float = 0.0) -> list[dict]:
+    """One row per (file, rung): the roofline columns plus a derived
+    fraction when the rung has GB/s but no fraction and a peak is given."""
+    rows = []
+    for p in paths:
+        result = load_result(p)
+        rungs = find_rungs(result.get("extra", {}))
+        # The headline tok_s lives at the result's top level, not in extra.
+        if "value" in result and result.get("value"):
+            for name, rung in rungs:
+                if name == "headline":
+                    rung.setdefault("tok_s", result["value"])
+        for name, rung in rungs:
+            row = {"file": p.name, "rung": name}
+            for col in COLUMNS:
+                if col in rung and isinstance(rung[col], (int, float)):
+                    row[col] = rung[col]
+            if ("roofline_fraction" not in row and peak_gbps
+                    and "hbm_gbps" in row):
+                row["roofline_fraction"] = round(
+                    row["hbm_gbps"] / peak_gbps, 3)
+            if len(row) > 2:                 # at least one measurement
+                rows.append(row)
+    return rows
+
+
+def format_table(rows: list[dict]) -> str:
+    if not rows:
+        return "(no rungs found)"
+    cols = ["file", "rung", *COLUMNS]
+    cols = [c for c in cols if any(c in r for r in rows)]
+    widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
+              for c in cols}
+    lines = ["  ".join(c.ljust(widths[c]) for c in cols),
+             "  ".join("-" * widths[c] for c in cols)]
+    for r in rows:
+        lines.append("  ".join(str(r.get(c, "")).ljust(widths[c])
+                               for c in cols))
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Flatten bench ladder JSON into a per-rung "
+                    "achieved-GB/s table")
+    ap.add_argument("files", nargs="+", type=Path)
+    ap.add_argument("--peak-gbps", type=float, default=0.0,
+                    help="derive roofline_fraction for rungs that report "
+                         "GB/s without one (v5e: 819)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit rows as JSON instead of a table")
+    args = ap.parse_args(argv)
+    rows = report(args.files, peak_gbps=args.peak_gbps)
+    if args.json:
+        print(json.dumps(rows, indent=2))
+    else:
+        print(format_table(rows))
+    return 0 if rows else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
