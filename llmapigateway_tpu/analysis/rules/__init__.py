@@ -13,6 +13,7 @@ from .async_blocking import RULE as ASYNC_BLOCKING
 from .lock_discipline import RULE as LOCK_DISCIPLINE
 from .secret_hygiene import RULE as SECRET_HYGIENE
 from .sse_protocol import RULE as SSE_PROTOCOL
+from .timeout_discipline import RULE as TIMEOUT_DISCIPLINE
 from .tracer_hazard import RULE as TRACER_HAZARD
 
 ALL_RULES: tuple[Rule, ...] = (
@@ -21,6 +22,7 @@ ALL_RULES: tuple[Rule, ...] = (
     LOCK_DISCIPLINE,
     SECRET_HYGIENE,
     SSE_PROTOCOL,
+    TIMEOUT_DISCIPLINE,
 )
 
 RULES_BY_NAME: dict[str, Rule] = {r.name: r for r in ALL_RULES}

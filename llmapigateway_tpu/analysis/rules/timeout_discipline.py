@@ -1,0 +1,92 @@
+"""timeout-discipline: every httpx request call in ``providers/`` must pass
+an explicit ``timeout=``.
+
+The reliability layer (ISSUE 3) caps each upstream attempt with the
+request's remaining deadline budget via :func:`deadline_timeout`. That cap
+only reaches the wire if the call site actually passes ``timeout=`` —
+httpx's silent fallback is the client's construction-time default, and a
+client built without one waits **5 s connect / 5 s read** per httpx's own
+default, or forever under a misconfigured transport. One forgotten
+``timeout=`` reintroduces exactly the unbounded-wait class of bug this PR
+removes, so the lint pins it:
+
+* ``<...client...>.get/post/put/patch/delete/request/stream/build_request``
+  — flagged when no ``timeout=`` keyword is present. Receivers qualify when
+  their terminal name contains ``client`` (``self._client``, ``client``,
+  ``models_client``), which is the project convention for httpx handles —
+  dict ``.get()`` and list ``.pop()`` never match.
+* ``httpx.AsyncClient(...)`` / ``httpx.Client(...)`` — the pooled client's
+  default timeout is the last line of defense; constructing one without
+  ``timeout=`` (or ``transport=``-only test shims without it) is flagged.
+
+``.send()`` is exempt: its timeout rides on the request object that
+``build_request(..., timeout=...)`` (itself checked) produced.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Rule
+from ._util import call_name
+
+_HTTP_METHODS = frozenset({"get", "post", "put", "patch", "delete",
+                           "request", "stream", "build_request"})
+_CLIENT_CONSTRUCTORS = frozenset({"httpx.AsyncClient", "httpx.Client"})
+
+
+def _terminal_receiver_name(func: ast.Attribute) -> str | None:
+    """The name the method is called on: ``client`` for ``client.post``,
+    ``_client`` for ``self._client.post``; None for dynamic receivers."""
+    recv = func.value
+    if isinstance(recv, ast.Attribute):
+        return recv.attr
+    if isinstance(recv, ast.Name):
+        return recv.id
+    return None
+
+
+def _has_timeout_kw(node: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in node.keywords)
+
+
+class TimeoutDisciplineRule(Rule):
+    name = "timeout-discipline"
+    description = ("httpx request calls (and client constructors) in "
+                   "providers/ must pass an explicit timeout= so deadline "
+                   "caps reach the wire")
+    dirs = ("providers",)
+
+    def check(self, tree: ast.Module, source: str,
+              relpath: str) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name in _CLIENT_CONSTRUCTORS:
+                if not _has_timeout_kw(node):
+                    findings.append(self.finding(
+                        relpath, node,
+                        f"{name}(...) without timeout=: the pooled "
+                        "client's default timeout is the last line of "
+                        "defense against unbounded upstream waits"))
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if func.attr not in _HTTP_METHODS:
+                continue
+            recv = _terminal_receiver_name(func)
+            if recv is None or "client" not in recv.lower():
+                continue               # dict.get(), payload.get(), etc.
+            if not _has_timeout_kw(node):
+                findings.append(self.finding(
+                    relpath, node,
+                    f"httpx {func.attr}() without explicit timeout=: pass "
+                    "deadline_timeout(request.deadline) (or a module "
+                    "timeout constant) so the request's budget caps the "
+                    "wire wait"))
+        return findings
+
+
+RULE = TimeoutDisciplineRule()

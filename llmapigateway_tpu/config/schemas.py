@@ -157,6 +157,24 @@ class LocalEngineConfig(BaseModel):
     debug_nans: bool = False
 
 
+class BreakerSettings(BaseModel):
+    """Per-provider circuit-breaker knobs (reliability/breaker.py, ISSUE 3).
+
+    Defaults are deliberately conservative: a provider must fail at least
+    half of a 5+-request window inside 30 s before the router stops paying
+    its timeouts, and gets a single half-open probe every ``cooldown_s``
+    until it recovers. Set ``enabled: false`` to opt a provider out (e.g.
+    a single-target chain where skipping the only target helps nobody).
+    """
+    model_config = ConfigDict(extra="forbid")
+
+    enabled: bool = True
+    window_s: float = Field(default=30.0, gt=0)       # sliding failure window
+    min_requests: int = Field(default=5, ge=1)        # samples before judging
+    failure_threshold: float = Field(default=0.5, gt=0, le=1.0)
+    cooldown_s: float = Field(default=15.0, gt=0)     # open → half-open probe
+
+
 class ProviderDetails(BaseModel):
     """One provider's connection/engine details.
 
@@ -170,6 +188,7 @@ class ProviderDetails(BaseModel):
     baseUrl: str | None = None
     apikey: str | None = None       # env-var name, or the literal key itself
     engine: LocalEngineConfig | None = None
+    breaker: BreakerSettings | None = None   # None → BreakerSettings defaults
 
     @field_validator("type")
     @classmethod
@@ -220,6 +239,12 @@ class ModelFallbackConfig(BaseModel):
     gateway_model_name: str
     fallback_models: list[FallbackModelRule]
     rotate_models: bool = False
+    # Default end-to-end time budget (ms) for requests to this gateway
+    # model when the client sends neither the `x-request-timeout-ms`
+    # header nor a `timeout_ms` body field. 0 = fall through to the
+    # gateway-wide DEFAULT_REQUEST_TIMEOUT_MS (which itself defaults to
+    # unbounded). Exhaustion returns HTTP 504 with per-attempt detail.
+    timeout_ms: float = Field(default=0.0, ge=0)
 
     @field_validator("rotate_models", mode="before")
     @classmethod

@@ -58,6 +58,11 @@ class Settings:
     usage_retention_days: int = 180
     log_level: str = "INFO"
     debug_mode: bool = False
+    # Gateway-wide default request time budget in ms (reliability layer,
+    # ISSUE 3). Per-request header/body and per-rule `timeout_ms` take
+    # precedence; 0 = unbounded (each attempt still bounded by the
+    # transport's 300 s cap).
+    default_request_timeout_ms: float = 0.0
     # Directories (relative to base_dir unless absolute)
     base_dir: Path = field(default_factory=Path.cwd)
     config_dir: Path | None = None
@@ -89,6 +94,8 @@ class Settings:
             log_chat_messages=_as_bool(merged.get("LOG_CHAT_MESSAGES"), False),
             log_level=merged.get("LOG_LEVEL", "INFO").upper(),
             debug_mode=_as_bool(merged.get("DEBUG_MODE"), False),
+            default_request_timeout_ms=float(
+                merged.get("DEFAULT_REQUEST_TIMEOUT_MS", "0") or 0),
             base_dir=base,
             config_dir=_path("CONFIG_DIR", "."),
             db_dir=_path("DB_DIR", "db"),

@@ -643,6 +643,10 @@ class InferenceEngine:
         self._queue_wait_n = 0                          # guarded-by: loop
         self._queue_wait_ema_ms: float | None = None    # guarded-by: loop
         self._queue_wait_max_ms = 0.0                   # guarded-by: loop
+        # Overload sheds (submit() raised EngineOverloaded on a full
+        # admission queue) — the gateway maps these to HTTP 429 with a
+        # Retry-After from retry_after_hint_s() (reliability, ISSUE 3).
+        self._shed_n = 0
         # Operator-facing gauge for /v1/api/engine-stats: EMA over ANY
         # steady same-depth burst (wall/depth, per-burst overhead
         # included) — the number an operator compares to the bench.
@@ -1168,9 +1172,23 @@ class InferenceEngine:
         try:
             self._queue.put_nowait(req)
         except asyncio.QueueFull:
+            self._shed_n += 1
             raise EngineOverloaded("engine admission queue is full") from None
         await self.start()
         self._work_event.set()
+
+    def retry_after_hint_s(self) -> float:
+        """How long a just-shed client should wait before retrying, from the
+        fitted step-time / queue-wait telemetry (ISSUE 3): the measured
+        admission wait plus one decode step per queued request ahead of it.
+        Bounded to [1, 30] s — a Retry-After, not a promise."""
+        step_ms = self._ema_step_ms_stats
+        if step_ms is None:
+            est = self._step_ms_estimate()
+            step_ms = est if est is not None else 0.0
+        wait_ms = self._queue_wait_ema_ms or 0.0
+        est_ms = wait_ms + step_ms * max(1, self._queue.qsize())
+        return min(30.0, max(1.0, est_ms / 1000.0))
 
     async def stream(self, req: GenRequest) -> AsyncIterator[Delta]:
         """Yield deltas for a submitted request until it finishes."""
@@ -2534,6 +2552,8 @@ class InferenceEngine:
             out["queue_wait_ms_ema"] = round(self._queue_wait_ema_ms, 1)
             out["queue_wait_ms_max"] = round(self._queue_wait_max_ms, 1)
             out["queue_waits"] = self._queue_wait_n
+        # Overload sheds (queue-full admissions the gateway 429'd).
+        out["shed_total"] = self._shed_n
         # Burst-depth controller diagnostics (ttft_target_ms): fitted
         # per-step slope, per-burst fixed cost, and where bursts actually
         # ran — the fields that turn an on-chip TTFT/throughput anomaly

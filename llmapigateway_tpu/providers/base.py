@@ -17,15 +17,27 @@ from __future__ import annotations
 
 import abc
 from dataclasses import dataclass, field
-from typing import Any, AsyncIterator, Protocol
+from typing import TYPE_CHECKING, Any, AsyncIterator, Protocol
+
+if TYPE_CHECKING:
+    from ..reliability.deadline import Deadline
 
 
 @dataclass
 class CompletionError:
-    """Why a provider call failed; feeds the retry/fallback state machine."""
+    """Why a provider call failed; feeds the retry/fallback state machine.
+
+    ``kind`` classifies failures the reliability layer treats specially:
+    ``"overload"`` (engine queue full / upstream shedding — the router maps
+    an all-overload chain to HTTP 429 with ``retry_after_s``) and
+    ``"timeout"`` (the attempt hit its deadline-capped transport timeout —
+    feeds the 504 path). ``""`` is every other failure.
+    """
     detail: str
     status: int | None = None
     retryable: bool = True
+    kind: str = ""                     # "" | "overload" | "timeout"
+    retry_after_s: float | None = None  # backpressure hint (kind="overload")
 
     def __str__(self) -> str:
         return f"[{self.status}] {self.detail}" if self.status else self.detail
@@ -79,10 +91,14 @@ CompletionResult = tuple[
 class CompletionRequest:
     """Everything a provider needs for one upstream attempt, post-routing:
     payload already rewritten to the provider-real model name with custom
-    body params merged (cf. chat.py:112-123)."""
+    body params merged (cf. chat.py:112-123). ``deadline`` is the request's
+    remaining end-to-end budget: remote providers cap their httpx timeouts
+    with it, the local provider bounds its first-token wait / decode drain
+    and cancels the engine slot on expiry."""
     payload: dict[str, Any]
     stream: bool
     extra_headers: dict[str, str] = field(default_factory=dict)
+    deadline: "Deadline | None" = None
 
 
 class Provider(abc.ABC):

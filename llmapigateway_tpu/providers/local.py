@@ -106,19 +106,42 @@ class LocalProvider(Provider):
             await self.engine.submit(req)
         except EngineOverloaded as e:
             # Overload is a *failable provider* condition: the router falls
-            # back to the next (e.g. remote) target — SURVEY.md §5.
-            return None, CompletionError(str(e), status=503)
+            # back to the next (e.g. remote) target — SURVEY.md §5 — and,
+            # when the WHOLE chain is overloaded, sheds with HTTP 429 +
+            # Retry-After from the engine's own telemetry (ISSUE 3).
+            hint = None
+            try:
+                hint = self.engine.retry_after_hint_s()
+            except Exception:       # stats must never break shedding
+                pass
+            return None, CompletionError(str(e), status=503,
+                                         kind="overload", retry_after_s=hint)
         except Exception as e:
             logger.exception("engine submit failed")
             return None, CompletionError(f"local engine error: {e}")
 
         # Wait for the first delta before committing (priming analog): if the
-        # engine fails before producing a token, the router can still fall back.
+        # engine fails before producing a token, the router can still fall
+        # back. A request deadline bounds this wait: on expiry the slot is
+        # cancelled (the engine stops decoding and frees it) and the attempt
+        # reports kind="timeout" so the router's 504 path takes over.
+        deadline = request.deadline
         stream_iter = self.engine.stream(req)
         try:
-            first_delta = await anext(stream_iter)
+            if deadline is not None:
+                first_delta = await asyncio.wait_for(
+                    anext(stream_iter), timeout=max(0.001, deadline.remaining()))
+            else:
+                first_delta = await anext(stream_iter)
         except StopAsyncIteration:
             return None, CompletionError("engine produced no output")
+        except asyncio.TimeoutError:
+            # The loop drops cancelled requests at its next admission /
+            # decode pass — the slot (or queue position) frees itself.
+            req.cancelled = True
+            return None, CompletionError(
+                "deadline expired before the local engine produced a token",
+                kind="timeout", retryable=False)
         if first_delta.error is not None:
             return None, CompletionError(first_delta.error)
 
@@ -141,6 +164,17 @@ class LocalProvider(Provider):
                     text_parts.append(delta.text)
                     finish = delta.finish_reason
                     error = delta.error
+                    if (finish is None and error is None
+                            and deadline is not None and deadline.expired()):
+                        # Decode cancellation on budget exhaustion: stop the
+                        # slot and report timeout — the router returns 504
+                        # (the client asked for a bounded wait, not a
+                        # truncated answer).
+                        req.cancelled = True
+                        observer.on_stream_end("deadline expired")
+                        return None, CompletionError(
+                            "deadline expired during local decode",
+                            kind="timeout", retryable=False)
         except asyncio.CancelledError:
             req.cancelled = True
             raise

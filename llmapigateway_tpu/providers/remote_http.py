@@ -22,6 +22,7 @@ from typing import Any, AsyncIterator
 
 import httpx
 
+from ..reliability.deadline import Deadline
 from ..utils.sse import SSE_DONE, SSEParser, format_sse, frame_error_detail
 from .base import (
     CompletionError,
@@ -38,6 +39,20 @@ logger = logging.getLogger(__name__)
 # Reference timeouts: 300 s total / 60 s connect (request_handler.py:15).
 DEFAULT_TIMEOUT = httpx.Timeout(300.0, connect=60.0)
 MODELS_TIMEOUT = httpx.Timeout(60.0, connect=10.0)
+
+
+def deadline_timeout(deadline: Deadline | None) -> httpx.Timeout:
+    """The per-attempt httpx timeout, capped by the request's remaining
+    deadline budget (reliability layer, ISSUE 3): an attempt may never
+    outlive the end-to-end budget the client asked for. With no deadline
+    the reference's 300 s / 60 s caps apply unchanged. An already-expired
+    deadline gets a tiny positive timeout so httpx raises a normal
+    ``TimeoutException`` (classified kind="timeout") instead of an
+    assertion deep in the transport."""
+    if deadline is None:
+        return DEFAULT_TIMEOUT
+    remaining = max(0.001, deadline.remaining())
+    return httpx.Timeout(min(300.0, remaining), connect=min(60.0, remaining))
 
 
 def _extract_content_delta(obj: dict[str, Any]) -> str:
@@ -76,12 +91,19 @@ class RemoteHTTPProvider(Provider):
                        observer: UsageObserver) -> CompletionResult:
         url = f"{self.base_url}/chat/completions"
         headers = self._headers(request.extra_headers)
+        timeout = deadline_timeout(request.deadline)
         try:
             if request.stream:
                 return await self._complete_streaming(
-                    url, headers, request.payload, observer)
+                    url, headers, request.payload, observer, timeout)
             return await self._complete_json(
-                url, headers, request.payload, observer)
+                url, headers, request.payload, observer, timeout)
+        except httpx.TimeoutException as e:
+            # Deadline-capped attempts land here; the router's budget check
+            # decides whether this terminates the whole request (504).
+            return None, CompletionError(
+                f"timeout contacting {self.name}: {type(e).__name__}",
+                kind="timeout")
         except httpx.HTTPError as e:
             return None, CompletionError(f"network error contacting {self.name}: {e}")
         except Exception as e:        # contract: never raise into the fallback loop
@@ -91,8 +113,10 @@ class RemoteHTTPProvider(Provider):
     # -- non-streaming -------------------------------------------------------
     async def _complete_json(self, url: str, headers: dict[str, str],
                              payload: dict[str, Any],
-                             observer: UsageObserver) -> CompletionResult:
-        resp = await self._client.post(url, json=payload, headers=headers)
+                             observer: UsageObserver,
+                             timeout: httpx.Timeout) -> CompletionResult:
+        resp = await self._client.post(url, json=payload, headers=headers,
+                                       timeout=timeout)
         if resp.status_code >= 400:
             return None, CompletionError(
                 resp.text[:2000], status=resp.status_code)
@@ -116,8 +140,10 @@ class RemoteHTTPProvider(Provider):
     # -- streaming -----------------------------------------------------------
     async def _complete_streaming(self, url: str, headers: dict[str, str],
                                   payload: dict[str, Any],
-                                  observer: UsageObserver) -> CompletionResult:
-        req = self._client.build_request("POST", url, json=payload, headers=headers)
+                                  observer: UsageObserver,
+                                  timeout: httpx.Timeout) -> CompletionResult:
+        req = self._client.build_request("POST", url, json=payload,
+                                         headers=headers, timeout=timeout)
         resp = await self._client.send(req, stream=True)
 
         if resp.status_code >= 400:
@@ -167,6 +193,11 @@ class RemoteHTTPProvider(Provider):
                 await resp.aclose()
                 return None, CompletionError(
                     f"{self.name} closed the stream before any data frame")
+        except httpx.TimeoutException as e:
+            await resp.aclose()
+            return None, CompletionError(
+                f"timeout during {self.name} stream priming: "
+                f"{type(e).__name__}", kind="timeout")
         except httpx.HTTPError as e:
             await resp.aclose()
             return None, CompletionError(f"stream setup failed: {e}")

@@ -1,0 +1,40 @@
+"""Reliability layer: deadline budgets, per-provider circuit breakers,
+overload shedding (ISSUE 3).
+
+The paper's fault-tolerance story — `local_tpu` as "just another entry in
+providers.json" — only works if a dead or drowning target costs the chain
+nothing: a request must carry an end-to-end time budget instead of waiting
+out `retry_count x retry_delay x 300 s` per target, a provider that keeps
+failing must be skipped *before* its timeout is paid (DistServe's framing:
+goodput is requests that finish inside their SLO, PAPERS.md), and overload
+must surface as backpressure the client can act on (429 + Retry-After)
+rather than a generic 503.
+
+Three small, clock-injectable pieces:
+
+* :class:`~.deadline.Deadline` — a monotonic per-request budget carried
+  from the HTTP layer through routing into provider attempts, where it
+  caps httpx timeouts, retry sleeps, and engine first-token waits.
+* :class:`~.breaker.CircuitBreaker` / :class:`~.breaker.BreakerRegistry` —
+  sliding-window failure-rate tracking per provider with
+  closed/open/half-open states; the router skips open breakers so a dead
+  upstream adds ~0 latency once detected.
+* failure classification (:func:`~.breaker.counts_as_breaker_failure`) —
+  which provider errors indicate an unhealthy upstream (network errors,
+  timeouts, 5xx, 429, engine overload) vs. a healthy upstream rejecting a
+  bad request (other 4xx).
+"""
+from .breaker import (
+    BreakerRegistry,
+    CircuitBreaker,
+    counts_as_breaker_failure,
+)
+from .deadline import Deadline, budget_ms_from_request
+
+__all__ = [
+    "BreakerRegistry",
+    "CircuitBreaker",
+    "Deadline",
+    "budget_ms_from_request",
+    "counts_as_breaker_failure",
+]

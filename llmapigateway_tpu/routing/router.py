@@ -2,7 +2,14 @@
 
 Behavior parity with the reference's routing loop — which lives inline in its
 API handler (``api/v1/chat.py:41-198``) — lifted into a service object so the
-HTTP layer stays thin (SURVEY.md §7 step 2). Semantics preserved:
+HTTP layer stays thin (SURVEY.md §7 step 2). Extended with the reliability
+layer (ISSUE 3): per-request deadline budgets (retry sleeps and remaining
+attempts clamped; exhaustion → 504 with partial-attempt detail),
+per-provider circuit breakers (open breakers are skipped instantly — a dead
+upstream stops costing its timeout on every request), fast-exit on
+non-retryable errors (same-target retries of a hopeless attempt are
+skipped), and overload shedding (an all-overload/all-open chain → 429 with
+a Retry-After the client can act on). Reference semantics preserved:
 
 * Rule lookup by gateway model name; unknown models become a synthetic
   single-target chain on the configured fallback provider with the model name
@@ -30,6 +37,7 @@ from __future__ import annotations
 import asyncio
 import copy
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
@@ -45,6 +53,8 @@ from ..providers.base import (
     UsageObserver,
 )
 from ..providers.remote_http import RemoteHTTPProvider
+from ..reliability.breaker import BreakerRegistry, counts_as_breaker_failure
+from ..reliability.deadline import Deadline
 
 logger = logging.getLogger(__name__)
 
@@ -185,12 +195,18 @@ class RouteOutcome:
 class Router:
     def __init__(self, loader: ConfigLoader, registry: ProviderRegistry,
                  rotation_db: RotationDB, fallback_provider: str = "openrouter",
-                 sleep: Callable[[float], Any] | None = None):
+                 sleep: Callable[[float], Any] | None = None,
+                 breakers: BreakerRegistry | None = None,
+                 default_timeout_ms: float = 0.0,
+                 clock: Callable[[], float] | None = None):
         self._loader = loader
         self._registry = registry
         self._rotation = rotation_db
         self._fallback_provider = fallback_provider
         self._sleep = sleep or asyncio.sleep     # injectable for tests
+        self._breakers = breakers
+        self._default_timeout_ms = default_timeout_ms
+        self._clock = clock or time.monotonic    # injectable for tests
 
     # -- rule resolution -----------------------------------------------------
     def resolve_rule(self, gateway_model: str) -> ModelFallbackConfig:
@@ -217,7 +233,8 @@ class Router:
     @staticmethod
     def _build_attempt(payload: dict[str, Any], target: FallbackModelRule,
                        provider_name: str,
-                       pinned_order: list[str] | None) -> CompletionRequest:
+                       pinned_order: list[str] | None,
+                       deadline: Deadline | None = None) -> CompletionRequest:
         attempt = copy.deepcopy(payload)
         attempt["model"] = target.model
         if provider_name.lower() == "openrouter":
@@ -235,27 +252,67 @@ class Router:
             headers.update(target.custom_headers)
         stream = bool(attempt.get("stream", False))
         return CompletionRequest(payload=attempt, stream=stream,
-                                 extra_headers=headers)
+                                 extra_headers=headers, deadline=deadline)
 
     # -- the state machine -----------------------------------------------------
+    def _start_deadline(self, rule: ModelFallbackConfig,
+                        timeout_ms: float | None) -> Deadline | None:
+        """Resolve the request's time budget: explicit client ask (header /
+        body, parsed by the HTTP layer) > per-rule ``timeout_ms`` >
+        gateway-wide default; 0/None at every level = unbounded."""
+        budget_ms = timeout_ms or rule.timeout_ms or self._default_timeout_ms
+        if not budget_ms or budget_ms <= 0:
+            return None
+        return Deadline(budget_ms / 1000.0, clock=self._clock)
+
     async def dispatch(self, payload: dict[str, Any], client_key: str,
-                       observer_factory: Callable[[str, str], UsageObserver]) -> RouteOutcome:
+                       observer_factory: Callable[[str, str], UsageObserver],
+                       timeout_ms: float | None = None) -> RouteOutcome:
         """Route one chat-completions payload through the fallback chain.
 
         ``observer_factory(provider, model)`` builds a fresh usage observer
         per attempt; only the successful attempt's observer sees a complete
-        stream, so usage is recorded exactly once.
+        stream, so usage is recorded exactly once. ``timeout_ms`` is the
+        client's explicit budget (x-request-timeout-ms header / timeout_ms
+        body field), if any.
         """
         gateway_model = str(payload.get("model", ""))
         rule = self.resolve_rule(gateway_model)
         targets = await self._ordered_targets(rule, client_key)
+        deadline = self._start_deadline(rule, timeout_ms)
 
         outcome = RouteOutcome(result=None, error=None)
+        # Terminal-status classification (ISSUE 3): 504 when the budget ran
+        # out, 429 when EVERY failure was backpressure (engine/upstream
+        # overload or an open breaker) so the client gets a Retry-After it
+        # can act on, 503 otherwise.
+        n_overload = 0
+        n_other = 0
+        deadline_hit = False
+        retry_hints: list[float] = []
+
         for target in targets:
+            if deadline is not None and deadline.expired():
+                deadline_hit = True
+                break
             provider = await self._registry.get(target.provider)
             if provider is None:
                 outcome.errors.append(
                     f"provider {target.provider!r} unavailable")
+                n_other += 1
+                continue
+
+            breaker = (self._breakers.get(target.provider)
+                       if self._breakers is not None else None)
+            if breaker is not None and not breaker.allow():
+                # Open breaker: fall through instantly — no payload build,
+                # no network, no retry sleeps for a known-dead upstream.
+                cooldown = breaker.cooldown_remaining()
+                outcome.errors.append(
+                    f"{target.provider}/{target.model}: circuit open "
+                    f"(retry in {cooldown:.1f}s)")
+                retry_hints.append(cooldown)
+                n_overload += 1
                 continue
 
             # Sub-provider fallback: gateway loops OpenRouter upstreams one at
@@ -268,28 +325,94 @@ class Router:
                 sub_orders = [None]
 
             retries = max(0, int(target.retry_count))
+            target_done = False          # non-retryable / deadline fast-exit
+            target_attempted = False     # any attempt actually sent?
             for attempt_idx in range(retries + 1):
                 for sub_order in sub_orders:
+                    if deadline is not None and deadline.expired():
+                        deadline_hit = True
+                        target_done = True
+                        if breaker is not None and not target_attempted:
+                            # allow() may have reserved the half-open probe;
+                            # we never sent it — release, don't leak.
+                            breaker.release_probe()
+                        break
                     request = self._build_attempt(
-                        payload, target, target.provider, sub_order)
+                        payload, target, target.provider, sub_order, deadline)
                     observer = observer_factory(target.provider, target.model)
                     outcome.attempts += 1
+                    target_attempted = True
                     result, error = await provider.complete(request, observer)
                     if error is None and result is not None:
+                        if breaker is not None:
+                            breaker.record_success()
                         outcome.result = result
                         outcome.provider = target.provider
                         outcome.model = target.model
                         return outcome
+                    breaker_opened = False
+                    if breaker is not None:
+                        if counts_as_breaker_failure(error):
+                            breaker.record_failure()
+                            # This failure tripped (or re-tripped, for a
+                            # failed half-open probe) the breaker: the
+                            # window has judged this target dead — burning
+                            # the remaining same-target retries and sleeps
+                            # would be exactly the waste breakers exist to
+                            # stop.
+                            breaker_opened = breaker.state == "open"
+                        else:
+                            # Alive-but-rejecting (plain 4xx): not evidence
+                            # of an unhealthy upstream.
+                            breaker.record_success()
+                    if error is not None and error.kind == "overload":
+                        n_overload += 1
+                        if error.retry_after_s is not None:
+                            retry_hints.append(error.retry_after_s)
+                    else:
+                        n_other += 1
                     detail = str(error) if error else "empty response"
                     sub = f" (upstream={sub_order[0]})" if sub_order else ""
                     outcome.errors.append(
                         f"{target.provider}/{target.model}{sub}: {detail}")
                     logger.warning("attempt failed: %s", outcome.errors[-1])
+                    if breaker_opened or (error is not None
+                                          and not error.retryable):
+                        # Same-target retries of a non-retryable failure
+                        # (invalid request, deadline hit) or of a target
+                        # whose breaker just opened are pure waste — skip
+                        # straight to the next target (ISSUE 3 satellite;
+                        # previously burned the full retry loop).
+                        target_done = True
+                        break
+                if target_done:
+                    break
                 if attempt_idx < retries and 0 < target.retry_delay < MAX_RETRY_DELAY_S:
-                    await self._sleep(target.retry_delay)
+                    # Clamp the backoff sleep against the remaining budget: a
+                    # 119 s retry_delay must never outlive a 2 s deadline.
+                    delay = (deadline.clamp(target.retry_delay)
+                             if deadline is not None else target.retry_delay)
+                    if delay > 0:
+                        await self._sleep(delay)
+            if deadline_hit:
+                break
 
-        outcome.error = CompletionError(
-            detail="; ".join(outcome.errors[-5:]) or
-                   f"no providers available for {gateway_model!r}",
-            status=503, retryable=False)
+        if deadline is not None and (deadline_hit or deadline.expired()):
+            budget_ms = deadline.budget_s * 1000.0
+            outcome.error = CompletionError(
+                detail=(f"deadline of {budget_ms:.0f} ms exhausted after "
+                        f"{outcome.attempts} attempt(s): "
+                        + ("; ".join(outcome.errors[-5:]) or "no attempts made")),
+                status=504, retryable=False, kind="timeout")
+        elif n_overload > 0 and n_other == 0 and outcome.errors:
+            outcome.error = CompletionError(
+                detail="all providers overloaded or shedding: "
+                       + "; ".join(outcome.errors[-5:]),
+                status=429, retryable=True, kind="overload",
+                retry_after_s=max(retry_hints, default=1.0))
+        else:
+            outcome.error = CompletionError(
+                detail="; ".join(outcome.errors[-5:]) or
+                       f"no providers available for {gateway_model!r}",
+                status=503, retryable=False)
         return outcome

@@ -20,6 +20,7 @@ from ..config.settings import Settings
 from ..db.rotation import RotationDB
 from ..db.usage import UsageDB
 from ..providers.base import Provider
+from ..reliability.breaker import BreakerRegistry
 from ..routing.router import ProviderRegistry, Router
 from . import chat, config_api, models_api, profiler_api, stats_api
 from .middleware import (
@@ -45,8 +46,12 @@ class GatewayApp:
         self.usage_db = UsageDB(settings.db_dir or "db")
         self.rotation_db = RotationDB(settings.db_dir or "db")
         self.registry = ProviderRegistry(loader, local_factory=local_factory)
-        self.router = Router(loader, self.registry, self.rotation_db,
-                             fallback_provider=settings.fallback_provider)
+        self.breakers = BreakerRegistry(loader)
+        self.router = Router(
+            loader, self.registry, self.rotation_db,
+            fallback_provider=settings.fallback_provider,
+            breakers=self.breakers,
+            default_timeout_ms=settings.default_request_timeout_ms)
 
     async def close(self) -> None:
         await self.registry.close()
@@ -113,6 +118,8 @@ def build_app(settings: Settings | None = None,
     # Stats API
     app.router.add_get("/v1/api/usage-stats/{period}", stats_api.get_usage_stats)
     app.router.add_get("/v1/api/usage-records", stats_api.get_usage_records)
+    # Reliability: live circuit-breaker state per provider (ISSUE 3)
+    app.router.add_get("/v1/api/health/providers", stats_api.get_provider_health)
 
     # Observability: engine stats + on-demand device trace capture
     app.router.add_get("/v1/api/engine-stats", profiler_api.get_engine_stats)

@@ -5,16 +5,25 @@ contains the whole fallback loop — ``api/v1/chat.py:41-198``). Body is parsed
 as json5 for parity with the reference's lenient parsing (``chat.py:41``).
 Streaming responses are committed (200, SSE headers) only after routing has
 produced a primed stream, so upstream failures still fell back.
+
+Reliability mapping (ISSUE 3): the client's ``x-request-timeout-ms`` header
+(or ``timeout_ms`` body field) becomes the request's deadline budget;
+exhaustion returns **504** with the partial-attempt log, an all-overloaded /
+all-breaker-open chain returns **429** with a numeric ``Retry-After`` from
+the engine's telemetry or the breakers' cooldowns, and everything else
+keeps the reference's **503**.
 """
 from __future__ import annotations
 
 import functools
 import logging
+import math
 
 import json5
 from aiohttp import web
 
 from ..providers.base import JSONCompletion, StreamingCompletion
+from ..reliability.deadline import budget_ms_from_request
 from ..server.usage_capture import UsageCollector
 from .middleware import client_api_key
 
@@ -38,18 +47,33 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
             {"error": {"message": "missing required field 'model'", "code": 400}},
             status=400)
 
+    timeout_ms = budget_ms_from_request(request.headers, payload)
+
     observer_factory = functools.partial(
         _make_collector, payload=payload, gw=gw)
 
     outcome = await gw.router.dispatch(
-        payload, client_api_key(request), observer_factory)
+        payload, client_api_key(request), observer_factory,
+        timeout_ms=timeout_ms)
 
     if outcome.error is not None or outcome.result is None:
-        detail = str(outcome.error) if outcome.error else "no providers succeeded"
+        err = outcome.error
+        detail = str(err) if err else "no providers succeeded"
+        status = err.status if err and err.status in (429, 504) else 503
+        headers = {}
+        if status == 429:
+            # Numeric Retry-After (RFC 9110 delay-seconds) from the engine's
+            # step-time/queue-wait telemetry or the breakers' cooldowns.
+            headers["Retry-After"] = str(
+                max(1, math.ceil(err.retry_after_s or 1.0)))
+        message = {
+            429: f"Gateway overloaded. {detail}",
+            504: f"Request deadline exceeded. {detail}",
+        }.get(status, f"All fallback models failed. Last error: {detail}")
         return web.json_response(
-            {"error": {"message": f"All fallback models failed. Last error: {detail}",
-                       "code": 503, "attempts": outcome.attempts}},
-            status=503)
+            {"error": {"message": message, "code": status,
+                       "attempts": outcome.attempts}},
+            status=status, headers=headers)
 
     result = outcome.result
     if isinstance(result, JSONCompletion):

@@ -1,10 +1,15 @@
-"""Usage statistics API.
+"""Usage statistics + provider-health API.
 
 Parity with the reference's stats router (``api/v1/stats.py``):
 ``/v1/api/usage-stats/{period}`` with period ∈ {hour, day, week, month} over
 windows of 24 h / 2 w / 15 w / 365 d (``stats.py:41-56``), and paginated
 ``/v1/api/usage-records`` (``stats.py:65-83``). Extended with avg TTFT and
-tok/s columns from the extended usage schema.
+tok/s columns from the extended usage schema, and (ISSUE 3) with
+``/v1/api/health/providers`` — the live circuit-breaker view per provider:
+state, windowed failure rate, cooldown remaining, lifetime opens, and the
+last state transition. Configured providers with no traffic yet report as
+implicitly closed so the operator sees the full roster, not just the
+troubled part of it.
 """
 from __future__ import annotations
 
@@ -45,3 +50,25 @@ async def get_usage_records(request: web.Request) -> web.Response:
     total = await gw.usage_db.total_count_async()
     return web.json_response({"records": rows, "total": total,
                               "limit": limit, "offset": offset})
+
+
+async def get_provider_health(request: web.Request) -> web.Response:
+    """GET /v1/api/health/providers — breaker state per provider."""
+    gw = request.app["gateway"]
+    snapshot = gw.breakers.snapshot() if gw.breakers is not None else {}
+    providers = {}
+    for name, details in sorted(gw.loader.providers.items()):
+        entry = snapshot.pop(name, None) or {
+            "state": "closed", "failure_rate": 0.0, "window_requests": 0,
+            "cooldown_remaining_s": 0.0, "opens": 0, "last_transition": None,
+            "enabled": (details.breaker.enabled
+                        if details.breaker is not None else True),
+        }
+        entry["type"] = details.type
+        providers[name] = entry
+    # Breakers for providers since removed from config still report until
+    # their registry entry ages out — visibility beats tidiness here.
+    for name, entry in snapshot.items():
+        entry["type"] = "removed"
+        providers[name] = entry
+    return web.json_response({"providers": providers})

@@ -5,11 +5,19 @@ an in-process aiohttp server speaking ``/chat/completions`` (streaming and
 non-streaming) and ``/models``, with injectable fault behaviors:
 
 * fail the next N requests with an HTTP status;
+* per-request status script (``fail_statuses``) for 429/5xx bursts and
+  flapping upstreams (chaos harness, ISSUE 3);
 * return HTTP 200 whose SSE body carries an in-band error frame (the case
   first-frame priming exists for);
 * emit an error frame mid-stream after some healthy chunks;
+* kill the socket mid-SSE after N healthy frames (``disconnect_after_frames``);
 * omit the usage object;
-* arbitrary response delay.
+* arbitrary response delay (slow headers) / per-frame stream delay.
+
+Plus :class:`FaultyTransport` — an httpx mock transport for chaos tests
+that never need a real socket: scriptable connect-refused, timeouts,
+status bursts, slow responses, and mid-SSE disconnects, driving
+``RemoteHTTPProvider`` (which accepts an injected client) directly.
 """
 from __future__ import annotations
 
@@ -18,6 +26,7 @@ import json
 from dataclasses import dataclass, field
 from typing import Any
 
+import httpx
 from aiohttp import web
 
 
@@ -25,10 +34,17 @@ from aiohttp import web
 class FaultPlan:
     fail_next: int = 0                 # fail this many requests with fail_status
     fail_status: int = 500
+    # Status script: each request pops the next entry (0 = healthy 200).
+    # e.g. [429, 429, 0, 503, 0] models a 429 burst then a flapping 5xx.
+    fail_statuses: list[int] = field(default_factory=list)
     inband_error_next: int = 0         # HTTP 200 + SSE error frame as first frame
     midstream_error_after: int | None = None   # healthy chunks, then error frame
+    # Abort the TCP connection after N healthy SSE frames (no error frame,
+    # no [DONE]) — the mid-stream upstream crash case.
+    disconnect_after_frames: int | None = None
     omit_usage: bool = False
-    delay_s: float = 0.0
+    delay_s: float = 0.0               # slow headers: sleep before responding
+    stream_delay_s: float = 0.0        # per-frame sleep while streaming
     tokens: list[str] = field(default_factory=lambda: ["Hello", " ", "world", "!"])
 
 
@@ -63,6 +79,14 @@ class FakeUpstream:
                 {"error": {"message": "injected upstream failure",
                            "code": plan.fail_status}},
                 status=plan.fail_status)
+
+        if plan.fail_statuses:
+            status = plan.fail_statuses.pop(0)
+            if status:                 # 0 = healthy request in the script
+                return web.json_response(
+                    {"error": {"message": f"scripted {status} burst",
+                               "code": status}},
+                    status=status)
 
         model = payload.get("model", "fake-model")
         usage = {"prompt_tokens": 7, "completion_tokens": len(plan.tokens),
@@ -105,6 +129,15 @@ class FakeUpstream:
                             "code": 502})
                 await resp.write_eof()
                 return resp
+            if plan.disconnect_after_frames is not None \
+                    and i == plan.disconnect_after_frames:
+                # Upstream crash mid-SSE: kill the socket hard (RST), no
+                # error frame, no [DONE] — the gateway must still hand its
+                # client a well-formed SSE error frame (chaos satellite).
+                request.transport.abort()
+                return resp
+            if plan.stream_delay_s:
+                await asyncio.sleep(plan.stream_delay_s)
             await send(self._chunk(i, tok, model))
         final = {"id": "chatcmpl-fake-final", "object": "chat.completion.chunk",
                  "model": model,
@@ -117,6 +150,7 @@ class FakeUpstream:
         await resp.write_eof()
         return resp
 
+    # ------------------------------------------------------------------
     async def _models(self, request: web.Request) -> web.Response:
         return web.json_response({"object": "list", "data": [
             {"id": "fake-model-1", "object": "model", "owned_by": "fake",
@@ -128,3 +162,133 @@ class FakeUpstream:
                               "max_completion_tokens": 2048}},
             {"id": "fake-model-2", "object": "model", "owned_by": "fake"},
         ]})
+
+
+# ---------------------------------------------------------------------------
+# FaultyTransport: socketless chaos for RemoteHTTPProvider (ISSUE 3).
+# ---------------------------------------------------------------------------
+
+def _chat_ok_body(tokens: list[str]) -> dict[str, Any]:
+    return {"id": "chatcmpl-faulty", "object": "chat.completion",
+            "model": "fake-model",
+            "choices": [{"index": 0,
+                         "message": {"role": "assistant",
+                                     "content": "".join(tokens)},
+                         "finish_reason": "stop"}],
+            "usage": {"prompt_tokens": 3, "completion_tokens": len(tokens),
+                      "total_tokens": 3 + len(tokens)}}
+
+
+class _ScriptedSSEStream(httpx.AsyncByteStream):
+    """SSE byte stream that can die (httpx.ReadError) after N frames."""
+
+    def __init__(self, tokens: list[str], die_after: int | None = None):
+        self._tokens = tokens
+        self._die_after = die_after
+
+    async def __aiter__(self):
+        for i, tok in enumerate(self._tokens):
+            if self._die_after is not None and i == self._die_after:
+                raise httpx.ReadError("scripted mid-SSE disconnect")
+            chunk = {"id": f"chatcmpl-faulty-{i}",
+                     "object": "chat.completion.chunk", "model": "fake-model",
+                     "choices": [{"index": 0, "delta": {"content": tok},
+                                  "finish_reason": None}]}
+            yield f"data: {json.dumps(chunk)}\n\n".encode()
+        if self._die_after is not None and self._die_after >= len(self._tokens):
+            raise httpx.ReadError("scripted end-of-stream disconnect")
+        final = {"id": "chatcmpl-faulty-final",
+                 "object": "chat.completion.chunk", "model": "fake-model",
+                 "choices": [{"index": 0, "delta": {},
+                              "finish_reason": "stop"}],
+                 "usage": {"prompt_tokens": 3,
+                           "completion_tokens": len(self._tokens),
+                           "total_tokens": 3 + len(self._tokens)}}
+        yield f"data: {json.dumps(final)}\n\n".encode()
+        yield b"data: [DONE]\n\n"
+
+    async def aclose(self) -> None:
+        pass
+
+
+class FaultyTransport(httpx.AsyncBaseTransport):
+    """Scriptable httpx transport: one script step is consumed per request.
+
+    Steps (strings unless noted):
+
+    * ``"ok"`` — healthy 200 (JSON or SSE depending on the payload's
+      ``stream`` flag); also the behavior once the script runs dry.
+    * ``"connect_refused"`` — raise ``httpx.ConnectError`` (dead host).
+    * ``"timeout"`` — raise ``httpx.ConnectTimeout`` immediately (the
+      zero-wall-clock stand-in for an upstream that never answers).
+    * ``("slow", seconds)`` — honor the request's own timeout like a real
+      transport: if the scripted latency exceeds the caller's read/connect
+      timeout budget, sleep only that budget then raise
+      ``httpx.ReadTimeout``; otherwise sleep and answer 200.
+    * int — that HTTP status with a JSON error body (429/5xx bursts).
+    * ``("sse_die", n)`` — 200 SSE that raises ``httpx.ReadError`` after
+      ``n`` healthy frames (mid-stream disconnect past the priming point).
+    """
+
+    def __init__(self, script: list[Any] | None = None,
+                 tokens: list[str] | None = None):
+        self.script: list[Any] = list(script or [])
+        self.tokens = tokens if tokens is not None else ["Hello", " ", "world"]
+        self.requests: list[httpx.Request] = []
+
+    def _req_timeout_s(self, request: httpx.Request) -> float | None:
+        t = request.extensions.get("timeout") or {}
+        reads = [v for v in (t.get("read"), t.get("connect")) if v is not None]
+        return min(reads) if reads else None
+
+    async def handle_async_request(self, request: httpx.Request) -> httpx.Response:
+        self.requests.append(request)
+        step = self.script.pop(0) if self.script else "ok"
+
+        if step == "connect_refused":
+            raise httpx.ConnectError("connection refused", request=request)
+        if step == "timeout":
+            raise httpx.ConnectTimeout("scripted connect timeout",
+                                       request=request)
+        if isinstance(step, int):
+            return httpx.Response(
+                step, json={"error": {"message": f"scripted {step}",
+                                      "code": step}}, request=request)
+        if isinstance(step, tuple) and step[0] == "slow":
+            budget = self._req_timeout_s(request)
+            if budget is not None and budget < step[1]:
+                await asyncio.sleep(budget)
+                raise httpx.ReadTimeout("scripted slow upstream",
+                                        request=request)
+            await asyncio.sleep(step[1])
+            step = "ok"
+
+        stream_req = False
+        try:
+            stream_req = bool(json.loads(request.content or b"{}").get("stream"))
+        except (ValueError, TypeError):
+            pass
+
+        if isinstance(step, tuple) and step[0] == "sse_die":
+            return httpx.Response(
+                200, headers={"content-type": "text/event-stream"},
+                stream=_ScriptedSSEStream(self.tokens, die_after=step[1]),
+                request=request)
+
+        if stream_req:
+            return httpx.Response(
+                200, headers={"content-type": "text/event-stream"},
+                stream=_ScriptedSSEStream(self.tokens), request=request)
+        return httpx.Response(200, json=_chat_ok_body(self.tokens),
+                              request=request)
+
+
+def faulty_provider(script: list[Any], name: str = "chaos",
+                    tokens: list[str] | None = None):
+    """A RemoteHTTPProvider wired to a FaultyTransport (no sockets)."""
+    from llmapigateway_tpu.providers.remote_http import RemoteHTTPProvider
+    transport = FaultyTransport(script, tokens=tokens)
+    client = httpx.AsyncClient(transport=transport,
+                               timeout=httpx.Timeout(30.0, connect=5.0))
+    return RemoteHTTPProvider(name, "http://chaos.invalid/v1",
+                              client=client), transport

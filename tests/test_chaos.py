@@ -1,0 +1,809 @@
+"""Chaos matrix for the reliability layer (ISSUE 3): circuit breakers,
+deadline budgets, and overload shedding under injected faults.
+
+Tier-1-fast discipline: breakers and deadlines run on injectable clocks and
+a recorded fake sleep, so the whole matrix executes with no real sleep
+longer than 0.1 s — EXCEPT the one real-clock integration test
+(`test_integration_slow_upstream_504_within_budget`), whose ~0.5 s wait IS
+the behavior under test (a 500 ms budget must produce a 504 in ~that time).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import statistics
+import time
+
+import pytest
+
+from llmapigateway_tpu.config.loader import ConfigLoader
+from llmapigateway_tpu.config.schemas import BreakerSettings
+from llmapigateway_tpu.db.rotation import RotationDB
+from llmapigateway_tpu.providers.base import (
+    CompletionError,
+    CompletionRequest,
+    JSONCompletion,
+    NullUsageObserver,
+    Provider,
+)
+from llmapigateway_tpu.reliability import (
+    BreakerRegistry,
+    CircuitBreaker,
+    Deadline,
+    budget_ms_from_request,
+    counts_as_breaker_failure,
+)
+from llmapigateway_tpu.routing.router import Router
+from tests.fake_upstream import faulty_provider
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def make_breaker(clock, **kw) -> CircuitBreaker:
+    cfg = BreakerSettings(**{"min_requests": 2, "window_s": 60.0,
+                             "failure_threshold": 0.5, "cooldown_s": 5.0, **kw})
+    return CircuitBreaker("prov", cfg, clock=clock)
+
+
+# -- breaker state machine (no real time) -------------------------------------
+
+def test_breaker_opens_on_failure_rate():
+    clock = FakeClock()
+    br = make_breaker(clock)
+    assert br.allow() and br.state == "closed"
+    br.record_failure()
+    assert br.state == "closed"          # min_requests not met
+    br.record_failure()
+    assert br.state == "open"            # 2/2 failures >= 0.5
+    assert not br.allow()
+    assert 0 < br.cooldown_remaining() <= 5.0
+
+
+def test_breaker_halfopen_probe_success_closes():
+    clock = FakeClock()
+    br = make_breaker(clock)
+    br.record_failure(); br.record_failure()
+    assert br.state == "open"
+    clock.advance(5.0)                   # cooldown elapses
+    assert br.allow()                    # the single half-open probe
+    assert br.state == "half_open"
+    assert not br.allow()                # second concurrent probe refused
+    br.record_success()
+    assert br.state == "closed"
+    assert br.allow()
+    # Window was reset: one new failure doesn't instantly re-open.
+    br.record_failure()
+    assert br.state == "closed"
+
+
+def test_breaker_halfopen_probe_failure_reopens():
+    clock = FakeClock()
+    br = make_breaker(clock)
+    br.record_failure(); br.record_failure()
+    clock.advance(5.0)
+    assert br.allow()
+    br.record_failure()
+    assert br.state == "open"
+    assert not br.allow()                # fresh cooldown started
+    clock.advance(5.0)
+    assert br.allow()                    # probes again after the new cooldown
+
+
+def test_breaker_released_probe_can_be_retaken():
+    """A reserved half-open probe that was never sent (deadline expired
+    first) must be released, or the breaker refuses traffic forever."""
+    clock = FakeClock()
+    br = make_breaker(clock)
+    br.record_failure(); br.record_failure()
+    clock.advance(5.0)
+    assert br.allow()                    # probe reserved...
+    br.release_probe()                   # ...but never sent
+    assert br.allow()                    # next request can probe instead
+    br.record_success()
+    assert br.state == "closed"
+
+
+def test_breaker_window_prunes_old_failures():
+    clock = FakeClock()
+    br = make_breaker(clock, window_s=10.0, min_requests=3)
+    br.record_failure(); br.record_failure()
+    clock.advance(11.0)                  # both age out of the window
+    br.record_failure()
+    assert br.state == "closed"          # 1 sample < min_requests
+    assert br.failure_rate() == 1.0
+
+
+def test_breaker_successes_hold_it_closed():
+    clock = FakeClock()
+    br = make_breaker(clock, min_requests=4)
+    for _ in range(6):
+        br.record_success()
+    br.record_failure(); br.record_failure()
+    assert br.state == "closed"          # 2/8 = 0.25 < 0.5
+    assert br.snapshot()["window_requests"] == 8
+
+
+def test_breaker_disabled_never_opens():
+    clock = FakeClock()
+    br = make_breaker(clock, enabled=False)
+    for _ in range(20):
+        br.record_failure()
+    assert br.state == "closed" and br.allow()
+
+
+def test_failure_classification():
+    assert counts_as_breaker_failure(CompletionError("net error"))          # no status
+    assert counts_as_breaker_failure(CompletionError("x", status=500))
+    assert counts_as_breaker_failure(CompletionError("x", status=429))
+    assert counts_as_breaker_failure(CompletionError("t", kind="timeout"))
+    assert counts_as_breaker_failure(
+        CompletionError("o", status=503, kind="overload"))
+    assert not counts_as_breaker_failure(CompletionError("x", status=400))
+    assert not counts_as_breaker_failure(CompletionError("x", status=404))
+    assert not counts_as_breaker_failure(None)
+
+
+# -- deadline primitives ------------------------------------------------------
+
+def test_deadline_remaining_clamp_expired():
+    clock = FakeClock()
+    d = Deadline(0.5, clock=clock)
+    assert not d.expired() and d.remaining() == 0.5
+    assert d.clamp(10.0) == 0.5 and d.clamp(0.2) == 0.2
+    clock.advance(0.4)
+    assert round(d.remaining(), 6) == 0.1
+    clock.advance(0.2)
+    assert d.expired() and d.remaining() == 0.0 and d.clamp(5.0) == 0.0
+
+
+def test_budget_parsing_header_body_and_junk():
+    payload = {"model": "m", "timeout_ms": 9000}
+    # Header wins and the body field is popped either way (never forwarded).
+    assert budget_ms_from_request({"x-request-timeout-ms": "500"}, payload) == 500
+    assert "timeout_ms" not in payload
+    payload = {"model": "m", "timeout_ms": 750}
+    assert budget_ms_from_request({}, payload) == 750
+    assert "timeout_ms" not in payload
+    assert budget_ms_from_request({}, {"model": "m"}) is None
+    assert budget_ms_from_request({"x-request-timeout-ms": "nope"}, {}) is None
+    assert budget_ms_from_request({"x-request-timeout-ms": "-5"}, {}) is None
+    assert budget_ms_from_request({}, {"timeout_ms": 10 ** 12}) is None
+
+
+# -- router-level chaos (fake clock, fake sleep) ------------------------------
+
+PROVIDERS_FAST_BREAKER = """[
+  { "deadup": { "baseUrl": "http://127.0.0.1:1/v1", "apikey": "K",
+      "breaker": { "min_requests": 2, "window_s": 60,
+                   "failure_threshold": 0.5, "cooldown_s": 5 } } },
+  { "backup": { "baseUrl": "http://127.0.0.1:1/v1", "apikey": "K" } }
+]"""
+
+RULES_CHAIN = """[
+  { "gateway_model_name": "gw/chain",
+    "fallback_models": [
+      { "provider": "deadup", "model": "dead-model", "retry_count": %(retries)d,
+        "retry_delay": %(delay)s },
+      { "provider": "backup", "model": "backup-model" }
+    ]%(extra)s }
+]"""
+
+
+class ScriptedProvider(Provider):
+    """Returns errors from `script` (None = success), recording each call;
+    optionally advances a fake clock per attempt to model attempt cost."""
+
+    def __init__(self, name, script=None, clock=None, cost_s=0.0):
+        self.name = name
+        self.script = list(script or [])
+        self.clock = clock
+        self.cost_s = cost_s
+        self.calls: list[CompletionRequest] = []
+
+    async def complete(self, request, observer):
+        self.calls.append(request)
+        if self.clock is not None and self.cost_s:
+            self.clock.advance(self.cost_s)
+        err = self.script.pop(0) if self.script else None
+        if err is not None:
+            return None, err
+        observer.on_first_token()
+        observer.on_stream_end()
+        return JSONCompletion(data={"ok": True}, provider=self.name), None
+
+
+class StubRegistry:
+    def __init__(self, providers):
+        self.providers = providers
+
+    async def get(self, name):
+        return self.providers.get(name)
+
+
+def observer_factory(provider, model):
+    return NullUsageObserver()
+
+
+def chaos_router(tmp_path, providers, clock, sleeps=None,
+                 retries=0, delay=0.0, rule_extra="", default_timeout_ms=0.0):
+    (tmp_path / "providers.json").write_text(PROVIDERS_FAST_BREAKER)
+    (tmp_path / "models_fallback_rules.json").write_text(
+        RULES_CHAIN % {"retries": retries, "delay": delay, "extra": rule_extra})
+    loader = ConfigLoader(tmp_path, fallback_provider="backup")
+    recorded = sleeps if sleeps is not None else []
+
+    async def fake_sleep(s):
+        recorded.append(s)
+        clock.advance(s)
+
+    return Router(loader, StubRegistry(providers),
+                  RotationDB(tmp_path / "rotdb"),
+                  fallback_provider="backup", sleep=fake_sleep,
+                  breakers=BreakerRegistry(loader, clock=clock),
+                  default_timeout_ms=default_timeout_ms, clock=clock)
+
+
+def net_err():
+    return CompletionError("connect refused", status=None)
+
+
+async def test_dead_primary_breaker_opens_then_zero_cost(tmp_path):
+    """Acceptance: with a permanently-dead primary in a 2-target chain, the
+    breaker opens after the failure window fills, after which the dead
+    target adds < 5 ms p50 (no attempts, no retry sleeps) — and a half-open
+    probe restores it after recovery."""
+    clock = FakeClock()
+    sleeps = []
+    dead = ScriptedProvider("deadup", script=[net_err()] * 100)
+    backup = ScriptedProvider("backup")
+    router = chaos_router(tmp_path, {"deadup": dead, "backup": backup},
+                          clock, sleeps, retries=1, delay=2.0)
+
+    # Two requests: 2 attempts each on the dead primary (retry_count=1)
+    # → 4 recorded failures → breaker open after the first request's pair.
+    out = await router.dispatch({"model": "gw/chain", "messages": []}, "k",
+                                observer_factory)
+    assert out.provider == "backup" and len(dead.calls) == 2
+    assert sleeps == [2.0]              # pre-breaker: the retry sleep is paid
+
+    # Breaker now open: dispatches skip the primary entirely and instantly.
+    timings = []
+    for _ in range(11):
+        t0 = time.perf_counter()
+        out = await router.dispatch({"model": "gw/chain", "messages": []},
+                                    "k", observer_factory)
+        timings.append(time.perf_counter() - t0)
+        assert out.provider == "backup"
+    assert len(dead.calls) == 2          # not a single further attempt
+    assert sleeps == [2.0]               # and no further retry sleeps
+    assert statistics.median(timings) < 0.005   # < 5 ms p50 with dead primary
+    assert "circuit open" in " ".join(out.errors)
+
+    # Recovery: upstream comes back; after cooldown ONE half-open probe goes
+    # through, succeeds, and the primary serves again.
+    dead.script = []                     # healthy from here on
+    clock.advance(5.0)
+    out = await router.dispatch({"model": "gw/chain", "messages": []}, "k",
+                                observer_factory)
+    assert out.provider == "deadup" and len(dead.calls) == 3
+    out = await router.dispatch({"model": "gw/chain", "messages": []}, "k",
+                                observer_factory)
+    assert out.provider == "deadup"      # closed again, normal traffic
+
+
+async def test_retries_fast_exit_once_breaker_opens_midloop(tmp_path):
+    """A breaker that opens PART-WAY through a target's retry loop aborts
+    the remaining same-target retries and sleeps (found driving the live
+    gateway: a failed half-open probe used to burn the whole retry budget
+    on a known-dead target)."""
+    clock = FakeClock()
+    sleeps = []
+    dead = ScriptedProvider("deadup", script=[net_err()] * 50)
+    backup = ScriptedProvider("backup")
+    router = chaos_router(tmp_path, {"deadup": dead, "backup": backup},
+                          clock, sleeps, retries=5, delay=1.0)
+    out = await router.dispatch({"model": "gw/chain", "messages": []}, "k",
+                                observer_factory)
+    assert out.provider == "backup"
+    # min_requests=2: attempt 1 (closed), sleep, attempt 2 -> breaker opens
+    # -> remaining 4 retries skipped.
+    assert len(dead.calls) == 2
+    assert sleeps == [1.0]
+
+
+async def test_flapping_upstream_reopens_on_failed_probe(tmp_path):
+    clock = FakeClock()
+    dead = ScriptedProvider("deadup", script=[net_err()] * 3)
+    backup = ScriptedProvider("backup")
+    router = chaos_router(tmp_path, {"deadup": dead, "backup": backup}, clock)
+
+    for _ in range(2):                   # 2 failures → open
+        await router.dispatch({"model": "gw/chain", "messages": []}, "k",
+                              observer_factory)
+    assert len(dead.calls) == 2
+    clock.advance(5.0)                   # half-open: probe fails → re-open
+    out = await router.dispatch({"model": "gw/chain", "messages": []}, "k",
+                                observer_factory)
+    assert len(dead.calls) == 3 and out.provider == "backup"
+    out = await router.dispatch({"model": "gw/chain", "messages": []}, "k",
+                                observer_factory)
+    assert len(dead.calls) == 3          # still open: skipped instantly
+    clock.advance(5.0)                   # next probe succeeds → closed
+    out = await router.dispatch({"model": "gw/chain", "messages": []}, "k",
+                                observer_factory)
+    assert out.provider == "deadup" and len(dead.calls) == 4
+
+
+async def test_deadline_504_with_partial_attempt_detail(tmp_path):
+    """A 500 ms budget against a slow, retrying chain: attempts and sleeps
+    are clamped to the budget and the terminal error is a 504 carrying the
+    partial-attempt log (fake clock — zero wall time)."""
+    clock = FakeClock()
+    sleeps = []
+    slow = ScriptedProvider("deadup", script=[net_err()] * 10,
+                            clock=clock, cost_s=0.3)
+    backup = ScriptedProvider("backup", script=[net_err()] * 10,
+                              clock=clock, cost_s=0.3)
+    router = chaos_router(tmp_path, {"deadup": slow, "backup": backup},
+                          clock, sleeps, retries=3, delay=10.0)
+
+    out = await router.dispatch({"model": "gw/chain", "messages": []}, "k",
+                                observer_factory, timeout_ms=500)
+    assert out.error is not None and out.error.status == 504
+    assert out.error.kind == "timeout"
+    assert "deadline of 500 ms exhausted" in out.error.detail
+    assert "connect refused" in out.error.detail    # partial-attempt detail
+    # Attempt 1 costs 0.3 s; the 10 s retry sleep is clamped to the 0.2 s
+    # remaining; the next attempt check sees the budget gone. The backup
+    # target is never reached — the chain stops the moment time runs out.
+    assert out.attempts == 1
+    assert sleeps == [pytest.approx(0.2)]
+    assert len(backup.calls) == 0
+
+
+async def test_rule_level_timeout_default_applies(tmp_path):
+    clock = FakeClock()
+    slow = ScriptedProvider("deadup", script=[net_err()] * 10,
+                            clock=clock, cost_s=0.4)
+    backup = ScriptedProvider("backup", script=[net_err()] * 10,
+                              clock=clock, cost_s=0.4)
+    router = chaos_router(tmp_path, {"deadup": slow, "backup": backup},
+                          clock, rule_extra=', "timeout_ms": 600')
+    out = await router.dispatch({"model": "gw/chain", "messages": []}, "k",
+                                observer_factory)
+    assert out.error is not None and out.error.status == 504
+    assert "600 ms" in out.error.detail
+
+
+async def test_gateway_default_timeout_applies(tmp_path):
+    clock = FakeClock()
+    slow = ScriptedProvider("deadup", script=[net_err()] * 10,
+                            clock=clock, cost_s=0.4)
+    backup = ScriptedProvider("backup", script=[net_err()] * 10,
+                              clock=clock, cost_s=0.4)
+    router = chaos_router(tmp_path, {"deadup": slow, "backup": backup},
+                          clock, default_timeout_ms=500.0)
+    out = await router.dispatch({"model": "gw/chain", "messages": []}, "k",
+                                observer_factory)
+    assert out.error is not None and out.error.status == 504
+
+
+async def test_deadline_timeout_error_is_not_retried(tmp_path):
+    """A kind="timeout" attempt error (deadline-capped transport timeout) is
+    non-retryable by classification: the target is abandoned immediately."""
+    clock = FakeClock()
+    t_err = CompletionError("timeout contacting deadup", kind="timeout",
+                            retryable=False)
+    slow = ScriptedProvider("deadup", script=[t_err] * 5)
+    backup = ScriptedProvider("backup")
+    router = chaos_router(tmp_path, {"deadup": slow, "backup": backup},
+                          clock, retries=3, delay=1.0)
+    out = await router.dispatch({"model": "gw/chain", "messages": []}, "k",
+                                observer_factory)
+    assert out.provider == "backup"
+    assert len(slow.calls) == 1          # no same-target retries
+
+
+async def test_all_overloaded_sheds_429_with_retry_after(tmp_path):
+    clock = FakeClock()
+    overload = CompletionError("engine admission queue is full", status=503,
+                               kind="overload", retry_after_s=2.5)
+    p1 = ScriptedProvider("deadup", script=[overload] * 5)
+    p2 = ScriptedProvider("backup", script=[overload] * 5)
+    router = chaos_router(tmp_path, {"deadup": p1, "backup": p2}, clock)
+    out = await router.dispatch({"model": "gw/chain", "messages": []}, "k",
+                                observer_factory)
+    assert out.error is not None and out.error.status == 429
+    assert out.error.kind == "overload"
+    assert out.error.retry_after_s == 2.5
+    assert out.error.retryable
+
+
+async def test_mixed_overload_and_failure_stays_503(tmp_path):
+    clock = FakeClock()
+    overload = CompletionError("queue full", status=503, kind="overload")
+    p1 = ScriptedProvider("deadup", script=[overload] * 5)
+    p2 = ScriptedProvider("backup", script=[net_err()] * 5)
+    router = chaos_router(tmp_path, {"deadup": p1, "backup": p2}, clock)
+    out = await router.dispatch({"model": "gw/chain", "messages": []}, "k",
+                                observer_factory)
+    assert out.error is not None and out.error.status == 503
+
+
+async def test_breaker_open_everywhere_sheds_429(tmp_path):
+    """Both targets' breakers open → the chain is pure backpressure: 429
+    with Retry-After from the soonest half-open probe."""
+    clock = FakeClock()
+    p1 = ScriptedProvider("deadup", script=[net_err()] * 50)
+    p2 = ScriptedProvider("backup", script=[net_err()] * 50)
+    router = chaos_router(tmp_path, {"deadup": p1, "backup": p2}, clock)
+    # Default breaker for "backup" needs min_requests=5 failures; "deadup"
+    # opens after 2. Drive both open.
+    for _ in range(5):
+        await router.dispatch({"model": "gw/chain", "messages": []}, "k",
+                              observer_factory)
+    n1, n2 = len(p1.calls), len(p2.calls)
+    out = await router.dispatch({"model": "gw/chain", "messages": []}, "k",
+                                observer_factory)
+    assert (len(p1.calls), len(p2.calls)) == (n1, n2)   # nobody attempted
+    assert out.error is not None and out.error.status == 429
+    assert out.error.retry_after_s is not None and out.error.retry_after_s > 0
+
+
+# -- provider-level chaos via FaultyTransport (no sockets) --------------------
+
+async def test_faulty_transport_connect_refused_and_recovery():
+    provider, transport = faulty_provider(["connect_refused", "ok"])
+    result, error = await provider.complete(
+        CompletionRequest(payload={"model": "m"}, stream=False),
+        NullUsageObserver())
+    assert result is None and error is not None
+    assert error.status is None and counts_as_breaker_failure(error)
+    result, error = await provider.complete(
+        CompletionRequest(payload={"model": "m"}, stream=False),
+        NullUsageObserver())
+    assert error is None and result.data["choices"]
+    await provider.close()
+
+
+async def test_faulty_transport_timeout_classified():
+    provider, _ = faulty_provider(["timeout"])
+    result, error = await provider.complete(
+        CompletionRequest(payload={"model": "m"}, stream=False),
+        NullUsageObserver())
+    assert result is None and error.kind == "timeout"
+    await provider.close()
+
+
+async def test_faulty_transport_slow_honors_deadline_cap():
+    """A slow upstream against a deadline-capped attempt times out at the
+    budget, not at the transport's 300 s default (real wait ~0.05 s)."""
+    clock_budget = Deadline(0.05)
+    provider, _ = faulty_provider([("slow", 30.0)])
+    t0 = time.perf_counter()
+    result, error = await provider.complete(
+        CompletionRequest(payload={"model": "m"}, stream=False,
+                          deadline=clock_budget),
+        NullUsageObserver())
+    elapsed = time.perf_counter() - t0
+    assert result is None and error.kind == "timeout"
+    assert elapsed < 1.0
+    await provider.close()
+
+
+async def test_faulty_transport_429_burst_then_recovery():
+    provider, _ = faulty_provider([429, 503, "ok"])
+    req = CompletionRequest(payload={"model": "m"}, stream=False)
+    _, e1 = await provider.complete(req, NullUsageObserver())
+    _, e2 = await provider.complete(req, NullUsageObserver())
+    r3, e3 = await provider.complete(req, NullUsageObserver())
+    assert e1.status == 429 and counts_as_breaker_failure(e1)
+    assert e2.status == 503 and counts_as_breaker_failure(e2)
+    assert e3 is None and r3 is not None
+    await provider.close()
+
+
+async def test_faulty_transport_midsse_disconnect_yields_error_frame():
+    """Disconnect after priming: the relay must end with a well-formed SSE
+    error frame and report the error to the observer."""
+    class Obs(NullUsageObserver):
+        ended_with = "unset"
+
+        def on_stream_end(self, error=None):
+            self.ended_with = error
+
+    obs = Obs()
+    provider, _ = faulty_provider([("sse_die", 2)])
+    result, error = await provider.complete(
+        CompletionRequest(payload={"model": "m", "stream": True}, stream=True),
+        obs)
+    assert error is None                 # priming saw a healthy first frame
+    frames = []
+    async for chunk in result.frames:
+        frames.append(chunk)
+    last = json.loads(frames[-1].decode().removeprefix("data: "))
+    assert "error" in last and last["error"]["provider"] == "chaos"
+    assert obs.ended_with is not None and "stream" in obs.ended_with
+    await provider.close()
+
+
+async def test_faulty_transport_preprime_disconnect_allows_fallback():
+    """Disconnect BEFORE the first data frame: the provider must return an
+    error (no committed stream), so the router can still fall back."""
+    provider, _ = faulty_provider([("sse_die", 0)])
+    result, error = await provider.complete(
+        CompletionRequest(payload={"model": "m", "stream": True}, stream=True),
+        NullUsageObserver())
+    assert result is None and error is not None
+    await provider.close()
+
+
+# -- local provider: deadline + overload against a stub engine ----------------
+
+class _StubTokenizer:
+    bos_id = None
+
+    def apply_chat_template(self, messages, add_generation_prompt=True):
+        return "hi"
+
+    def encode(self, text):
+        return [1, 2, 3]
+
+
+class _StubEngineBase:
+    class cfg:
+        max_tokens_default = 8
+
+    tokenizer = _StubTokenizer()
+
+    def retry_after_hint_s(self) -> float:
+        return 2.5
+
+
+async def test_local_provider_overload_carries_retry_after_hint():
+    from llmapigateway_tpu.engine.engine import EngineOverloaded
+    from llmapigateway_tpu.providers.local import LocalProvider
+
+    class OverloadedEngine(_StubEngineBase):
+        async def submit(self, req):
+            raise EngineOverloaded("engine admission queue is full")
+
+    provider = LocalProvider("tpu", OverloadedEngine())
+    result, error = await provider.complete(
+        CompletionRequest(payload={"model": "m", "messages": []},
+                          stream=False),
+        NullUsageObserver())
+    assert result is None
+    assert error.kind == "overload" and error.status == 503
+    assert error.retry_after_s == 2.5
+    assert counts_as_breaker_failure(error)
+
+
+async def test_local_provider_first_token_deadline_cancels_request():
+    """The engine never produces a token: a 50 ms deadline bounds the wait
+    (instead of hanging forever) and marks the request cancelled so the
+    engine loop frees the slot."""
+    from llmapigateway_tpu.providers.local import LocalProvider
+
+    submitted = []
+
+    class StuckEngine(_StubEngineBase):
+        async def submit(self, req):
+            submitted.append(req)
+
+        async def stream(self, req):
+            await asyncio.Event().wait()     # never yields
+            yield None                       # pragma: no cover
+
+    provider = LocalProvider("tpu", StuckEngine())
+    t0 = time.perf_counter()
+    result, error = await provider.complete(
+        CompletionRequest(payload={"model": "m", "messages": []},
+                          stream=False, deadline=Deadline(0.05)),
+        NullUsageObserver())
+    assert time.perf_counter() - t0 < 1.0
+    assert result is None and error.kind == "timeout"
+    assert not error.retryable
+    assert submitted[0].cancelled            # slot will be reclaimed
+
+
+async def test_local_provider_decode_deadline_cancels_midway():
+    """First token arrives, then the budget expires mid-decode: the drain
+    stops, the slot is cancelled, the attempt reports timeout (fake clock —
+    no real waiting)."""
+    from llmapigateway_tpu.engine.engine import Delta
+    from llmapigateway_tpu.providers.local import LocalProvider
+
+    clock = FakeClock()
+    submitted = []
+
+    class SlowDecodeEngine(_StubEngineBase):
+        async def submit(self, req):
+            submitted.append(req)
+
+        async def stream(self, req):
+            yield Delta(text="a")
+            while True:                      # each delta costs 0.3 budget-s
+                clock.advance(0.3)
+                yield Delta(text="b")
+
+    provider = LocalProvider("tpu", SlowDecodeEngine())
+    result, error = await provider.complete(
+        CompletionRequest(payload={"model": "m", "messages": []},
+                          stream=False,
+                          deadline=Deadline(0.5, clock=clock)),
+        NullUsageObserver())
+    assert result is None and error.kind == "timeout"
+    assert submitted[0].cancelled
+
+
+# -- full-server integration --------------------------------------------------
+
+async def test_integration_slow_upstream_504_within_budget(tmp_path):
+    """Acceptance: `x-request-timeout-ms: 500` against an upstream that never
+    sends headers returns 504 in ~600 ms wall clock (real clock on purpose —
+    the one chaos test allowed to wait, see module docstring)."""
+    from tests.test_server_integration import Gateway
+
+    async with Gateway(tmp_path) as g:
+        g.up.plan.delay_s = 30.0         # slow headers; cut short by timeout
+        t0 = time.perf_counter()
+        resp = await g.client.post(
+            "/v1/chat/completions",
+            json={"model": "gw/chat", "messages": []},
+            headers={"x-request-timeout-ms": "500"})
+        elapsed = time.perf_counter() - t0
+        assert resp.status == 504
+        body = await resp.json()
+        assert "deadline" in body["error"]["message"].lower()
+        assert body["error"]["attempts"] == 1
+        assert elapsed < 0.9             # 0.5 s budget + overhead margin
+
+
+async def test_integration_timeout_ms_body_field(tmp_path):
+    """The `timeout_ms` body field works too, and is never forwarded
+    upstream."""
+    from tests.test_server_integration import Gateway
+
+    async with Gateway(tmp_path) as g:
+        resp = await g.client.post(
+            "/v1/chat/completions",
+            json={"model": "gw/chat", "messages": [], "timeout_ms": 5000})
+        assert resp.status == 200
+        assert "timeout_ms" not in g.up.requests[0]
+
+
+class OverloadedLocalProvider(Provider):
+    """Stands in for a LocalProvider whose engine admission queue is full."""
+    type = "local"
+
+    def __init__(self, name):
+        self.name = name
+
+    async def complete(self, request, observer):
+        return None, CompletionError(
+            "engine admission queue is full", status=503,
+            kind="overload", retry_after_s=2.2)
+
+
+async def test_integration_engine_queue_full_returns_429(tmp_path):
+    """Acceptance: engine queue-full maps to HTTP 429 with a NUMERIC
+    Retry-After (derived from engine telemetry), not the generic 503."""
+    import json as _json
+    from aiohttp.test_utils import TestClient, TestServer
+    from llmapigateway_tpu.config.settings import Settings
+    from llmapigateway_tpu.server.app import GatewayApp, build_app
+
+    (tmp_path / "providers.json").write_text(_json.dumps([
+        {"local_tpu": {"type": "local", "engine": {"preset": "tiny-test"}}}]))
+    (tmp_path / "models_fallback_rules.json").write_text(_json.dumps([
+        {"gateway_model_name": "gw/local", "fallback_models": [
+            {"provider": "local_tpu", "model": "gw/local"}]}]))
+    settings = Settings(fallback_provider="local_tpu", base_dir=tmp_path,
+                        config_dir=tmp_path, db_dir=tmp_path / "db",
+                        logs_dir=tmp_path / "logs")
+    loader = ConfigLoader(tmp_path, fallback_provider="local_tpu")
+    gw = GatewayApp(settings, loader,
+                    local_factory=lambda name, details:
+                    OverloadedLocalProvider(name))
+    app = build_app(settings, loader, gateway=gw)
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    try:
+        resp = await client.post("/v1/chat/completions", json={
+            "model": "gw/local", "messages": []})
+        assert resp.status == 429
+        assert resp.headers["Retry-After"] == "3"        # ceil(2.2)
+        body = await resp.json()
+        assert "overload" in body["error"]["message"].lower()
+    finally:
+        await client.close()
+
+
+async def test_integration_midsse_disconnect_error_frame_and_usage(tmp_path):
+    """Satellite: upstream kills the socket after 2 SSE frames. The CLIENT
+    must still receive a well-formed SSE error frame (not a truncated
+    stream), and usage capture must record the partial stream."""
+    from tests.test_server_integration import Gateway
+
+    async with Gateway(tmp_path) as g:
+        g.up.plan.disconnect_after_frames = 2
+        resp = await g.client.post("/v1/chat/completions", json={
+            "model": "gw/chat", "stream": True,
+            "messages": [{"role": "user", "content": "hi"}]})
+        assert resp.status == 200        # already committed at priming time
+        raw_frames = []
+        async for line in resp.content:
+            line = line.decode().strip()
+            if line.startswith("data: "):
+                raw_frames.append(line[len("data: "):])
+        # Two healthy content frames, then one structured error frame.
+        assert len(raw_frames) == 3
+        texts = [json.loads(f)["choices"][0]["delta"].get("content")
+                 for f in raw_frames[:2]]
+        assert texts == ["Hello", " "]
+        last = json.loads(raw_frames[-1])
+        assert "error" in last and last["error"]["provider"] == "fakeup"
+        # Usage capture recorded the partial stream (offloaded write).
+        await asyncio.sleep(0.1)
+        resp = await g.client.get("/v1/api/usage-records")
+        body = await resp.json()
+        assert body["total"] == 1
+        assert body["records"][0]["provider"] == "fakeup"
+        transcripts = list((tmp_path / "logs").glob("*.txt"))
+        assert transcripts and "Hello " in transcripts[0].read_text()
+
+
+async def test_integration_provider_health_endpoint(tmp_path):
+    """/v1/api/health/providers: full roster with implicit-closed entries;
+    a failing provider's breaker state/failure counts show up live."""
+    from tests.test_server_integration import Gateway
+
+    async with Gateway(tmp_path) as g:
+        resp = await g.client.get("/v1/api/health/providers")
+        assert resp.status == 200
+        providers = (await resp.json())["providers"]
+        assert providers["fakeup"]["state"] == "closed"
+        assert providers["fakeup"]["window_requests"] == 0
+        assert providers["fakeup"]["type"] == "remote_http"
+
+        g.up.plan.fail_next = 3
+        for _ in range(3):
+            await g.client.post("/v1/chat/completions",
+                                json={"model": "gw/chat", "messages": []})
+        resp = await g.client.get("/v1/api/health/providers")
+        health = (await resp.json())["providers"]["fakeup"]
+        assert health["window_requests"] == 3
+        assert health["failure_rate"] == 1.0
+        assert health["state"] == "closed"   # min_requests=5 not reached yet
+
+
+async def test_integration_5xx_burst_retries_then_recovers(tmp_path):
+    """A scripted 429/5xx burst inside the retry budget still ends in a 200
+    once the upstream heals (fail_statuses script, chaos harness)."""
+    import json as _json
+    from tests.test_server_integration import Gateway
+
+    async with Gateway(tmp_path) as g:
+        # Rewrite the rule to allow 2 same-target retries, no delay.
+        (tmp_path / "models_fallback_rules.json").write_text(_json.dumps([
+            {"gateway_model_name": "gw/chat", "fallback_models": [
+                {"provider": "fakeup", "model": "real-a",
+                 "retry_count": 2, "retry_delay": 0.0}]}]))
+        g.gw.loader.reload_rules()
+        g.up.plan.fail_statuses = [429, 500, 0]
+        resp = await g.client.post("/v1/chat/completions", json={
+            "model": "gw/chat", "messages": []})
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["choices"][0]["message"]["content"] == "Hello world!"
+        assert len(g.up.requests) == 3

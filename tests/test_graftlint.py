@@ -288,6 +288,57 @@ def test_sse_protocol_scoped_to_streaming_files():
     assert "sse-protocol" not in rules_hit(SSE_BAD, "engine/fixture.py")
 
 
+TIMEOUT_BAD = """
+    import httpx
+
+    class P:
+        def __init__(self):
+            self._client = httpx.AsyncClient()            # no default timeout
+
+        async def complete(self, url, payload):
+            resp = await self._client.post(url, json=payload)
+            req = self._client.build_request("POST", url, json=payload)
+            inventory = await self._client.get(url)
+            return resp, req, inventory
+"""
+
+TIMEOUT_GOOD = """
+    import httpx
+
+    TIMEOUT = httpx.Timeout(300.0, connect=60.0)
+
+    class P:
+        def __init__(self, client=None):
+            self._client = client or httpx.AsyncClient(timeout=TIMEOUT)
+
+        async def complete(self, url, payload):
+            resp = await self._client.post(url, json=payload, timeout=TIMEOUT)
+            req = self._client.build_request("POST", url, json=payload,
+                                             timeout=TIMEOUT)
+            sent = await self._client.send(req, stream=True)   # rides req
+            model = payload.get("model", "")                   # dict .get: not httpx
+            return resp, sent, model
+"""
+
+
+def test_timeout_discipline_fires_on_bad():
+    findings = lint(TIMEOUT_BAD, "providers/fixture.py")
+    assert {f.rule for f in findings} == {"timeout-discipline"}
+    msgs = " | ".join(f.message for f in findings)
+    assert "httpx.AsyncClient" in msgs
+    assert "post()" in msgs and "build_request()" in msgs and "get()" in msgs
+    assert len(findings) == 4
+
+
+def test_timeout_discipline_silent_on_good():
+    assert rules_hit(TIMEOUT_GOOD, "providers/fixture.py") == set()
+
+
+def test_timeout_discipline_scoped_to_providers():
+    assert "timeout-discipline" not in rules_hit(TIMEOUT_BAD,
+                                                 "server/fixture.py")
+
+
 # -- suppressions -------------------------------------------------------------
 
 def test_trailing_suppression_is_line_scoped():

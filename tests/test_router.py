@@ -157,6 +157,33 @@ async def test_custom_params_headers_and_provider_order(tmp_path):
     assert por.calls[0].extra_headers["X-Custom"] == "yes"
 
 
+async def test_non_retryable_error_skips_same_target_retries(config_dir, tmp_path):
+    """Regression (ISSUE 3 satellite): a CompletionError(retryable=False) —
+    e.g. the local provider's invalid-request error — used to burn the full
+    per-target retry loop (sleeps included). It must fail the target on the
+    FIRST attempt and move straight to the next target."""
+    class NonRetryable(Provider):
+        def __init__(self, name):
+            self.name = name
+            self.calls = []
+
+        async def complete(self, request, observer):
+            self.calls.append(request)
+            return None, CompletionError("invalid request for local engine",
+                                         retryable=False)
+
+    sleeps = []
+    p1 = NonRetryable("fakeup")          # rule gives fakeup retry_count=1
+    p2 = ScriptedProvider("openrouter")
+    router = make_router(config_dir, tmp_path,
+                         {"fakeup": p1, "openrouter": p2}, sleeps=sleeps)
+    outcome = await router.dispatch({"model": "gw/test-model", "messages": []},
+                                    "k", observer_factory)
+    assert outcome.error is None and outcome.provider == "openrouter"
+    assert len(p1.calls) == 1            # no same-target retry
+    assert sleeps == []                  # and no retry_delay sleep burned
+
+
 async def test_use_provider_order_as_fallback(tmp_path):
     """Sub-provider loop: each upstream pinned one at a time (chat.py:158-189)."""
     (tmp_path / "providers.json").write_text(
