@@ -11,6 +11,7 @@ from __future__ import annotations
 from ..core import Rule
 from .async_blocking import RULE as ASYNC_BLOCKING
 from .lock_discipline import RULE as LOCK_DISCIPLINE
+from .metric_discipline import RULE as METRIC_DISCIPLINE
 from .secret_hygiene import RULE as SECRET_HYGIENE
 from .sse_protocol import RULE as SSE_PROTOCOL
 from .timeout_discipline import RULE as TIMEOUT_DISCIPLINE
@@ -23,6 +24,7 @@ ALL_RULES: tuple[Rule, ...] = (
     SECRET_HYGIENE,
     SSE_PROTOCOL,
     TIMEOUT_DISCIPLINE,
+    METRIC_DISCIPLINE,
 )
 
 RULES_BY_NAME: dict[str, Rule] = {r.name: r for r in ALL_RULES}

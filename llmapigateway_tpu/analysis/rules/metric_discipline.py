@@ -1,0 +1,82 @@
+"""metric-discipline: metric naming and span-lifecycle invariants for the
+observability plane (ISSUE 4).
+
+Two checks:
+
+* **Metric names.** Every instrument registered via
+  ``<registry>.counter("name", ...)`` / ``.gauge(`` / ``.histogram(`` (first
+  argument a string literal) must be snake_case and end with a unit suffix
+  — ``_seconds``, ``_bytes``, ``_total``, or ``_ratio``. Unit-suffixed
+  names are what make the one-scrape exposition legible (a bare
+  ``gateway_latency`` tells an operator nothing about ms vs s) and keep
+  PromQL aggregations dimensionally sane.
+
+* **Span lifecycle.** Spans may only be opened through the context-manager
+  API (``with span(...)``) — a bare ``begin_span(`` call outside
+  ``obs/trace.py`` has no paired close on the exception path, and a leaked
+  open span turns every downstream trace read into a lie. The tracer's own
+  module is exempt: it is where the context manager (and the post-hoc
+  ``record_span``) are built from the primitive.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..core import Finding, Rule
+from ._util import call_name
+
+_FACTORY_METHODS = frozenset({"counter", "gauge", "histogram"})
+_UNIT_SUFFIXES = ("_seconds", "_bytes", "_total", "_ratio")
+_SNAKE_RE = re.compile(r"[a-z][a-z0-9_]*\Z")
+
+# The one module allowed to touch the span primitive.
+_TRACE_MODULE = "obs/trace.py"
+
+
+class MetricDisciplineRule(Rule):
+    name = "metric-discipline"
+    description = ("metric names must be snake_case with a unit suffix "
+                   "(_seconds/_bytes/_total/_ratio); spans open only via "
+                   "the context-manager API (no bare begin_span() calls)")
+
+    def check(self, tree: ast.Module, source: str,
+              relpath: str) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            # -- bare begin_span( anywhere outside the tracer module ------
+            called = func.attr if isinstance(func, ast.Attribute) else (
+                func.id if isinstance(func, ast.Name) else None)
+            if called == "begin_span" and relpath != _TRACE_MODULE:
+                findings.append(self.finding(
+                    relpath, node,
+                    "bare begin_span() call: open spans via the context "
+                    "manager (`with span(...):`) so they cannot leak "
+                    "unclosed past an exception"))
+                continue
+            # -- instrument registration naming ---------------------------
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in _FACTORY_METHODS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                metric_name = node.args[0].value
+                if not _SNAKE_RE.fullmatch(metric_name):
+                    findings.append(self.finding(
+                        relpath, node,
+                        f"metric name {metric_name!r} is not snake_case "
+                        "([a-z][a-z0-9_]*)"))
+                elif not metric_name.endswith(_UNIT_SUFFIXES):
+                    findings.append(self.finding(
+                        relpath, node,
+                        f"metric name {metric_name!r} lacks a unit suffix "
+                        f"({', '.join(_UNIT_SUFFIXES)}) — name the unit so "
+                        "the exposition and PromQL stay dimensionally "
+                        "sane"))
+        return findings
+
+
+RULE = MetricDisciplineRule()

@@ -135,6 +135,7 @@ class GenRequest:
     cancelled: bool = False        # client gone — stop generating, free slot
     finish_reason: str | None = None
     t_submit: float = field(default_factory=time.monotonic)
+    t_admitted: float | None = None   # slot admission (queued-phase end)
     t_first_token: float | None = None
     t_done: float | None = None
 
@@ -1270,7 +1271,9 @@ class InferenceEngine:
             req.slot = self._free_slots.pop()
             # Queue-wait gauge (submit → slot admission): the scheduler
             # half of TTFT — what the prefill-aware burst clamp bounds.
-            wait_ms = 1000.0 * (time.monotonic() - req.t_submit)
+            # t_admitted also closes the trace's engine.queued phase.
+            req.t_admitted = time.monotonic()
+            wait_ms = 1000.0 * (req.t_admitted - req.t_submit)
             self._queue_wait_n += 1
             self._queue_wait_ema_ms = (
                 wait_ms if self._queue_wait_ema_ms is None

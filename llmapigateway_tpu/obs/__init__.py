@@ -1,0 +1,31 @@
+"""Unified observability plane (ISSUE 4): the metrics registry serving
+``GET /metrics`` and the request tracer serving
+``GET /v1/api/trace/{request_id}``. Dependency-free by design — importable
+from every layer (middleware, router, providers, engine bridges) without
+pulling in JAX or HTTP stacks."""
+from .metrics import (
+    LATENCY_BUCKETS_S,
+    Counter,
+    Gauge,
+    GatewayMetrics,
+    Histogram,
+    MetricsRegistry,
+    get_metrics,
+)
+from .trace import (
+    Span,
+    Tracer,
+    current_request_id,
+    current_span,
+    current_trace,
+    record_span,
+    server_timing_header,
+    span,
+)
+
+__all__ = [
+    "LATENCY_BUCKETS_S", "Counter", "Gauge", "GatewayMetrics", "Histogram",
+    "MetricsRegistry", "get_metrics",
+    "Span", "Tracer", "current_request_id", "current_span", "current_trace",
+    "record_span", "server_timing_header", "span",
+]

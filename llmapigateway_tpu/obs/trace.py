@@ -1,0 +1,229 @@
+"""End-to-end request tracing: per-request span trees via ``contextvars``.
+
+One request becomes one tree — gateway root → router attempt N → provider
+call → engine slot phases (queued / prefill / first-token / decode /
+drain) — answering "where did request X spend its 742 ms" from a single
+``GET /v1/api/trace/{request_id}`` read instead of four correlated log
+streams (ISSUE 4). Design:
+
+* The logging middleware opens the root span for the request's lifetime
+  (its ``finally`` closes it even when a handler raises mid-stream), and
+  every layer nests under whatever span is current in its context.
+* Spans are opened ONLY through the :func:`span` context manager — the
+  graftlint ``metric-discipline`` rule forbids bare :func:`begin_span`
+  calls outside this module, so a span cannot leak unclosed past an
+  exception.
+* Layers that measure time outside the request task (the engine loop)
+  report post-hoc through :func:`record_span` with explicit
+  ``time.monotonic`` timestamps — the default tracer clock — against a
+  parent captured while their provider call was current.
+* Finished (and in-flight) traces live in a bounded in-process ring
+  buffer; no exporter, no sampling — the newest ``capacity`` requests are
+  queryable, which is what an operator chasing a live latency anomaly
+  needs.
+
+Without an active trace every API here is a no-op, so unit tests (and the
+engine bench) never pay for or depend on tracing.
+"""
+from __future__ import annotations
+
+import contextvars
+import re
+import time
+from collections import OrderedDict
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterator
+
+DEFAULT_CAPACITY = 256
+
+_SERVER_TIMING_SAFE = re.compile(r"[^A-Za-z0-9_]")
+_MAX_TIMING_ENTRIES = 16
+
+
+@dataclass
+class Span:
+    """One timed operation. ``end is None`` means still open (a finished
+    trace with an open non-root span is a leak — the chaos tests assert
+    there are none)."""
+    name: str
+    layer: str
+    start: float
+    attrs: dict[str, Any] = field(default_factory=dict)
+    end: float | None = None
+    children: list["Span"] = field(default_factory=list)
+    _clock: Callable[[], float] = field(default=time.monotonic, repr=False)
+
+    def duration_ms(self) -> float | None:
+        if self.end is None:
+            return None
+        return (self.end - self.start) * 1000.0
+
+    def to_dict(self, epoch: float) -> dict[str, Any]:
+        d: dict[str, Any] = {
+            "name": self.name, "layer": self.layer,
+            "start_ms": round((self.start - epoch) * 1000.0, 3),
+            "duration_ms": (round(self.duration_ms(), 3)
+                            if self.end is not None else None)}
+        if self.attrs:
+            d["attrs"] = dict(self.attrs)
+        if self.children:
+            d["children"] = [c.to_dict(epoch) for c in self.children]
+        return d
+
+    def walk(self) -> Iterator["Span"]:
+        yield self
+        for child in self.children:
+            yield from child.walk()
+
+
+class RequestTrace:
+    """The span tree of one request."""
+
+    def __init__(self, request_id: str, clock: Callable[[], float]):
+        self.request_id = request_id
+        self.clock = clock
+        self.root = Span("gateway", "gateway", clock(), _clock=clock)
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"request_id": self.request_id,
+                "complete": self.root.end is not None,
+                "spans": self.root.to_dict(self.root.start)}
+
+
+class Tracer:
+    """Ring buffer of recent request traces. Event-loop confined (the
+    middleware is the only writer of the buffer itself)."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 clock: Callable[[], float] = time.monotonic):
+        self.capacity = capacity
+        self._clock = clock
+        self._traces: "OrderedDict[str, RequestTrace]" = OrderedDict()
+
+    @contextmanager
+    def trace(self, request_id: str) -> Iterator[RequestTrace]:
+        """Open the root span for one request; queryable immediately (an
+        in-flight request reports ``complete: false``)."""
+        tr = RequestTrace(request_id, self._clock)
+        self._traces[request_id] = tr
+        self._traces.move_to_end(request_id)
+        while len(self._traces) > self.capacity:
+            self._traces.popitem(last=False)
+        tok_trace = _trace_var.set(tr)
+        tok_span = _span_var.set(tr.root)
+        try:
+            yield tr
+        finally:
+            tr.root.end = self._clock()
+            _span_var.reset(tok_span)
+            _trace_var.reset(tok_trace)
+
+    def get(self, request_id: str) -> dict[str, Any] | None:
+        tr = self._traces.get(request_id)
+        return tr.to_dict() if tr is not None else None
+
+    def __len__(self) -> int:
+        return len(self._traces)
+
+
+_trace_var: contextvars.ContextVar[RequestTrace | None] = \
+    contextvars.ContextVar("gateway_trace", default=None)
+_span_var: contextvars.ContextVar[Span | None] = \
+    contextvars.ContextVar("gateway_span", default=None)
+
+
+def current_trace() -> RequestTrace | None:
+    return _trace_var.get()
+
+
+def current_span() -> Span | None:
+    return _span_var.get()
+
+
+def current_request_id() -> str | None:
+    """The active trace's request id — what outbound provider calls
+    propagate upstream as ``x-request-id``."""
+    tr = _trace_var.get()
+    return tr.request_id if tr is not None else None
+
+
+def begin_span(name: str, layer: str = "gateway",
+               parent: Span | None = None, **attrs: Any) -> Span | None:
+    """Low-level span open. Application code MUST use :func:`span` (the
+    graftlint metric-discipline rule rejects bare ``begin_span(`` calls
+    outside this module); this exists so the context manager and
+    :func:`record_span` share one attach path."""
+    tr = _trace_var.get()
+    if tr is None:
+        return None
+    if parent is None:
+        parent = _span_var.get() or tr.root
+    sp = Span(name, layer, tr.clock(), dict(attrs), _clock=tr.clock)
+    parent.children.append(sp)
+    return sp
+
+
+def end_span(sp: Span | None) -> None:
+    if sp is not None and sp.end is None:
+        sp.end = sp._clock()
+
+
+@contextmanager
+def span(name: str, layer: str = "gateway", **attrs: Any) -> Iterator[Span | None]:
+    """Open a child span of the current context's span for the duration of
+    the ``with`` block. No-op (yields None) without an active trace."""
+    sp = begin_span(name, layer, **attrs)
+    if sp is None:
+        yield None
+        return
+    tok = _span_var.set(sp)
+    try:
+        yield sp
+    finally:
+        end_span(sp)
+        _span_var.reset(tok)
+
+
+def record_span(name: str, layer: str = "gateway",
+                start: float | None = None, end: float | None = None,
+                parent: Span | None = None, **attrs: Any) -> Span | None:
+    """Attach an already-finished span (post-hoc measurement, e.g. engine
+    phases timed by the scheduler loop). ``start``/``end`` are absolute
+    timestamps in the tracer's clock domain (``time.monotonic`` by
+    default); omitted ones default to now — so a bare call records a
+    zero-length event marker."""
+    tr = _trace_var.get()
+    if tr is None and parent is None:
+        return None
+    clock = tr.clock if tr is not None else parent._clock
+    now = clock()
+    sp = Span(name, layer, start if start is not None else now,
+              dict(attrs), end=end if end is not None else now,
+              _clock=clock)
+    if parent is None:
+        parent = _span_var.get() or tr.root
+    parent.children.append(sp)
+    return sp
+
+
+def server_timing_header(max_entries: int = _MAX_TIMING_ENTRIES) -> str:
+    """Summarize the current trace as a ``Server-Timing``-style value for
+    the ``x-gateway-timings`` response header: ``name;dur=ms`` entries in
+    tree order (root first as ``total``), closed spans only."""
+    tr = _trace_var.get()
+    if tr is None:
+        return ""
+    entries = []
+    root_dur = tr.root.duration_ms()
+    if root_dur is None:                    # header built before root close
+        root_dur = (tr.clock() - tr.root.start) * 1000.0
+    entries.append(f"total;dur={root_dur:.1f}")
+    for sp in tr.root.walk():
+        if sp is tr.root or sp.end is None:
+            continue
+        name = _SERVER_TIMING_SAFE.sub("_", sp.name)
+        entries.append(f"{name};dur={sp.duration_ms():.1f}")
+        if len(entries) >= max_entries:
+            break
+    return ", ".join(entries)

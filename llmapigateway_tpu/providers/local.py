@@ -21,6 +21,8 @@ import uuid
 from typing import Any, AsyncIterator
 
 from ..config.schemas import ProviderDetails
+from ..obs import trace as obs_trace
+from ..obs.metrics import GatewayMetrics, get_metrics
 from ..utils.sse import SSE_DONE, format_sse
 from .base import (
     CompletionError,
@@ -38,9 +40,53 @@ logger = logging.getLogger(__name__)
 class LocalProvider(Provider):
     type = "local"
 
-    def __init__(self, name: str, engine: "InferenceEngine"):
+    def __init__(self, name: str, engine: "InferenceEngine",
+                 metrics: GatewayMetrics | None = None):
         self.name = name
         self.engine = engine
+        self._metrics = metrics or get_metrics()
+
+    # -- engine-phase tracing --------------------------------------------------
+    # The engine loop runs outside the request's task, so its phases are
+    # reported post-hoc from the GenRequest's own timestamps (ISSUE 4):
+    # queued (submit → slot admission), prefill (admission → first token),
+    # then decode/drain recorded at stream end. `parent` is the
+    # provider.call span captured while complete() was current.
+
+    def _trace_admission(self, req, parent) -> None:
+        if req.t_first_token is None:
+            return
+        t_admit = req.t_admitted or req.t_submit
+        obs_trace.record_span("engine.queued", layer="engine",
+                              start=req.t_submit, end=t_admit, parent=parent)
+        obs_trace.record_span("engine.prefill", layer="engine",
+                              start=t_admit, end=req.t_first_token,
+                              parent=parent,
+                              prompt_tokens=len(req.prompt_ids))
+        obs_trace.record_span("engine.first_token", layer="engine",
+                              start=req.t_first_token, end=req.t_first_token,
+                              parent=parent)
+        self._metrics.engine_ttft_seconds.labels(engine=self.name).observe(
+            max(0.0, req.t_first_token - req.t_submit))
+
+    def _trace_decode(self, req, parent, error: str | None = None) -> None:
+        if req.t_first_token is None:
+            return
+        end = req.t_done if req.t_done is not None else time.monotonic()
+        attrs = {"tokens": len(req.generated)}
+        if req.finish_reason:
+            attrs["finish_reason"] = req.finish_reason
+        if error:
+            attrs["error"] = error[:200]
+        obs_trace.record_span("engine.decode", layer="engine",
+                              start=req.t_first_token, end=end,
+                              parent=parent, **attrs)
+        now = time.monotonic()
+        if req.t_done is not None and now > req.t_done:
+            # Emission drained after the engine finished (lag-one bursts +
+            # stop-sequence holdback flush through here).
+            obs_trace.record_span("engine.drain", layer="engine",
+                                  start=req.t_done, end=now, parent=parent)
 
     # -- request translation ---------------------------------------------------
     def _build_genrequest(self, payload: dict[str, Any]):
@@ -126,6 +172,7 @@ class LocalProvider(Provider):
         # cancelled (the engine stops decoding and frees it) and the attempt
         # reports kind="timeout" so the router's 504 path takes over.
         deadline = request.deadline
+        parent = obs_trace.current_span()
         stream_iter = self.engine.stream(req)
         try:
             if deadline is not None:
@@ -146,10 +193,12 @@ class LocalProvider(Provider):
             return None, CompletionError(first_delta.error)
 
         observer.on_first_token()
+        self._trace_admission(req, parent)
 
         if request.stream:
             frames = self._sse_frames(req, stream_iter, first_delta,
-                                      model_name, observer)
+                                      model_name, observer,
+                                      deadline=deadline, parent=parent)
             return StreamingCompletion(frames=frames, provider=self.name,
                                        model=model_name), None
 
@@ -172,6 +221,8 @@ class LocalProvider(Provider):
                         # truncated answer).
                         req.cancelled = True
                         observer.on_stream_end("deadline expired")
+                        self._trace_decode(req, parent,
+                                           error="deadline expired")
                         return None, CompletionError(
                             "deadline expired during local decode",
                             kind="timeout", retryable=False)
@@ -180,7 +231,9 @@ class LocalProvider(Provider):
             raise
         if error is not None:
             observer.on_stream_end(error)
+            self._trace_decode(req, parent, error=error)
             return None, CompletionError(error)
+        self._trace_decode(req, parent)
         text = "".join(text_parts)
         usage = self._usage(req)
         observer.on_content_delta(text)
@@ -201,9 +254,12 @@ class LocalProvider(Provider):
 
     async def _sse_frames(self, req, stream_iter: AsyncIterator,
                           first_delta, model_name: str,
-                          observer: UsageObserver) -> AsyncIterator[bytes]:
+                          observer: UsageObserver,
+                          deadline=None, parent=None) -> AsyncIterator[bytes]:
         cid = f"chatcmpl-{uuid.uuid4().hex[:24]}"
         created = int(time.time())
+        tbt = self._metrics.engine_time_between_tokens_seconds.labels(
+            engine=self.name)
 
         def chunk(delta_content: str | None, finish: str | None = None,
                   role: str | None = None, usage: dict | None = None) -> bytes:
@@ -222,6 +278,7 @@ class LocalProvider(Provider):
             return format_sse(body)
 
         error: str | None = None
+        last_t = time.monotonic()
         try:
             yield chunk(None, role="assistant")
             if first_delta.text:
@@ -230,10 +287,25 @@ class LocalProvider(Provider):
             finish = first_delta.finish_reason
             if finish is None:
                 async for delta in stream_iter:
+                    now = time.monotonic()
+                    tbt.observe(now - last_t)
+                    last_t = now
                     if delta.error is not None:
                         error = delta.error
                         yield format_sse({"error": {"message": error,
                                                     "provider": self.name}})
+                        return
+                    if (deadline is not None and deadline.expired()
+                            and delta.finish_reason is None):
+                        # Budget exhausted mid-stream: stop decoding, free
+                        # the slot, and end the committed stream with an
+                        # in-band error frame (the 200 is long since on the
+                        # wire — the 504 path only exists pre-commit).
+                        error = "deadline expired mid-stream"
+                        req.cancelled = True
+                        yield format_sse({"error": {
+                            "message": "request deadline expired mid-stream",
+                            "provider": self.name, "code": 504}})
                         return
                     if delta.text:
                         observer.on_content_delta(delta.text)
@@ -250,6 +322,7 @@ class LocalProvider(Provider):
                 # the engine to stop decoding and free the slot.
                 req.cancelled = True
             observer.on_stream_end(error)
+            self._trace_decode(req, parent, error=error)
 
     async def list_models(self) -> list[dict[str, Any]] | None:
         return [{"id": self.name, "object": "model", "owned_by": "local_tpu",

@@ -22,6 +22,7 @@ from typing import Any, AsyncIterator
 
 import httpx
 
+from ..obs import trace as obs_trace
 from ..reliability.deadline import Deadline
 from ..utils.sse import SSE_DONE, SSEParser, format_sse, frame_error_detail
 from .base import (
@@ -85,6 +86,14 @@ class RemoteHTTPProvider(Provider):
         if self.api_key:
             headers["Authorization"] = f"Bearer {self.api_key}"
         headers.update(extra)
+        if "x-request-id" not in {k.lower() for k in headers}:
+            # Propagate the gateway request id upstream (ISSUE 4). The
+            # router already stamps routed attempts; this covers direct
+            # provider calls (e.g. /v1/models aggregation) made while a
+            # request trace is active.
+            req_id = obs_trace.current_request_id()
+            if req_id:
+                headers["x-request-id"] = req_id
         return headers
 
     async def complete(self, request: CompletionRequest,

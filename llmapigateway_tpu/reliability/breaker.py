@@ -144,6 +144,9 @@ class CircuitBreaker:
         self._prune()
         return {
             "state": self._state,
+            # Numeric twin of `state` for the metrics plane's
+            # gateway_provider_breaker_open_ratio gauge (ISSUE 4).
+            "state_code": {CLOSED: 0.0, HALF_OPEN: 0.5, OPEN: 1.0}[self._state],
             "failure_rate": round(self.failure_rate(), 3),
             "window_requests": len(self._events),
             "cooldown_remaining_s": round(self.cooldown_remaining(), 2),

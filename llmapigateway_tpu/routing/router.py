@@ -44,6 +44,8 @@ from typing import Any, Callable
 from ..config.loader import ConfigLoader, resolve_api_key
 from ..config.schemas import FallbackModelRule, ModelFallbackConfig, ProviderDetails
 from ..db.rotation import RotationDB
+from ..obs import trace as obs_trace
+from ..obs.metrics import GatewayMetrics, get_metrics
 from ..providers.base import (
     CompletionError,
     CompletionRequest,
@@ -198,7 +200,8 @@ class Router:
                  sleep: Callable[[float], Any] | None = None,
                  breakers: BreakerRegistry | None = None,
                  default_timeout_ms: float = 0.0,
-                 clock: Callable[[], float] | None = None):
+                 clock: Callable[[], float] | None = None,
+                 metrics: GatewayMetrics | None = None):
         self._loader = loader
         self._registry = registry
         self._rotation = rotation_db
@@ -207,6 +210,7 @@ class Router:
         self._breakers = breakers
         self._default_timeout_ms = default_timeout_ms
         self._clock = clock or time.monotonic    # injectable for tests
+        self._metrics = metrics or get_metrics()
 
     # -- rule resolution -----------------------------------------------------
     def resolve_rule(self, gateway_model: str) -> ModelFallbackConfig:
@@ -234,7 +238,8 @@ class Router:
     def _build_attempt(payload: dict[str, Any], target: FallbackModelRule,
                        provider_name: str,
                        pinned_order: list[str] | None,
-                       deadline: Deadline | None = None) -> CompletionRequest:
+                       deadline: Deadline | None = None,
+                       request_id: str = "") -> CompletionRequest:
         attempt = copy.deepcopy(payload)
         attempt["model"] = target.model
         if provider_name.lower() == "openrouter":
@@ -248,6 +253,10 @@ class Router:
             attempt.update(copy.deepcopy(target.custom_body_params))
         headers = {"HTTP-Referer": "https://llmapigateway-tpu.local",
                    "X-Title": "LLM API Gateway (TPU)"}
+        if request_id:
+            # Propagate the gateway's request id upstream so one id
+            # correlates gateway and provider logs (ISSUE 4).
+            headers["x-request-id"] = request_id
         if target.custom_headers:
             headers.update(target.custom_headers)
         stream = bool(attempt.get("stream", False))
@@ -267,19 +276,22 @@ class Router:
 
     async def dispatch(self, payload: dict[str, Any], client_key: str,
                        observer_factory: Callable[[str, str], UsageObserver],
-                       timeout_ms: float | None = None) -> RouteOutcome:
+                       timeout_ms: float | None = None,
+                       request_id: str = "") -> RouteOutcome:
         """Route one chat-completions payload through the fallback chain.
 
         ``observer_factory(provider, model)`` builds a fresh usage observer
         per attempt; only the successful attempt's observer sees a complete
         stream, so usage is recorded exactly once. ``timeout_ms`` is the
         client's explicit budget (x-request-timeout-ms header / timeout_ms
-        body field), if any.
+        body field), if any. ``request_id`` is propagated on outbound
+        provider requests (and labels this request's trace spans).
         """
         gateway_model = str(payload.get("model", ""))
         rule = self.resolve_rule(gateway_model)
         targets = await self._ordered_targets(rule, client_key)
         deadline = self._start_deadline(rule, timeout_ms)
+        m = self._metrics
 
         outcome = RouteOutcome(result=None, error=None)
         # Terminal-status classification (ISSUE 3): 504 when the budget ran
@@ -291,7 +303,7 @@ class Router:
         deadline_hit = False
         retry_hints: list[float] = []
 
-        for target in targets:
+        for target_idx, target in enumerate(targets):
             if deadline is not None and deadline.expired():
                 deadline_hit = True
                 break
@@ -313,6 +325,12 @@ class Router:
                     f"(retry in {cooldown:.1f}s)")
                 retry_hints.append(cooldown)
                 n_overload += 1
+                m.router_breaker_skips_total.labels(
+                    provider=target.provider).inc()
+                obs_trace.record_span(
+                    "router.breaker_skip", layer="router",
+                    provider=target.provider,
+                    cooldown_s=round(cooldown, 2))
                 continue
 
             # Sub-provider fallback: gateway loops OpenRouter upstreams one at
@@ -338,11 +356,36 @@ class Router:
                             breaker.release_probe()
                         break
                     request = self._build_attempt(
-                        payload, target, target.provider, sub_order, deadline)
+                        payload, target, target.provider, sub_order, deadline,
+                        request_id=request_id)
                     observer = observer_factory(target.provider, target.model)
                     outcome.attempts += 1
                     target_attempted = True
-                    result, error = await provider.complete(request, observer)
+                    m.router_attempts_total.labels(
+                        provider=target.provider).inc()
+                    t_attempt = self._clock()
+                    with obs_trace.span(
+                            "router.attempt", layer="router",
+                            provider=target.provider, model=target.model,
+                            attempt=outcome.attempts) as att_span:
+                        with obs_trace.span(
+                                "provider.call", layer="provider",
+                                provider=target.provider):
+                            result, error = await provider.complete(
+                                request, observer)
+                        if att_span is not None and error is not None:
+                            att_span.attrs["error"] = str(error)[:200]
+                    m.provider_attempt_duration_seconds.labels(
+                        provider=target.provider).observe(
+                            self._clock() - t_attempt)
+                    if error is not None:
+                        kind = error.kind or (
+                            "http" if error.status is not None else "error")
+                        m.provider_errors_total.labels(
+                            provider=target.provider, kind=kind).inc()
+                        if error.kind == "timeout":
+                            m.provider_timeouts_total.labels(
+                                provider=target.provider).inc()
                     if error is None and result is not None:
                         if breaker is not None:
                             breaker.record_success()
@@ -396,15 +439,21 @@ class Router:
                         await self._sleep(delay)
             if deadline_hit:
                 break
+            if target_attempted and target_idx < len(targets) - 1:
+                # Falling past an attempted-and-failed target to the next
+                # one in the chain — the fallback-hop counter.
+                m.router_fallbacks_total.inc()
 
         if deadline is not None and (deadline_hit or deadline.expired()):
             budget_ms = deadline.budget_s * 1000.0
+            m.router_deadline_expired_total.inc()
             outcome.error = CompletionError(
                 detail=(f"deadline of {budget_ms:.0f} ms exhausted after "
                         f"{outcome.attempts} attempt(s): "
                         + ("; ".join(outcome.errors[-5:]) or "no attempts made")),
                 status=504, retryable=False, kind="timeout")
         elif n_overload > 0 and n_other == 0 and outcome.errors:
+            m.router_sheds_total.inc()
             outcome.error = CompletionError(
                 detail="all providers overloaded or shedding: "
                        + "; ".join(outcome.errors[-5:]),

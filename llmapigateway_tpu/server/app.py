@@ -19,10 +19,12 @@ from ..config.loader import ConfigLoader
 from ..config.settings import Settings
 from ..db.rotation import RotationDB
 from ..db.usage import UsageDB
+from ..obs.metrics import GatewayMetrics, get_metrics
+from ..obs.trace import Tracer
 from ..providers.base import Provider
 from ..reliability.breaker import BreakerRegistry
 from ..routing.router import ProviderRegistry, Router
-from . import chat, config_api, models_api, profiler_api, stats_api
+from . import chat, config_api, models_api, obs_api, profiler_api, stats_api
 from .middleware import (
     auth_middleware,
     cors_middleware,
@@ -40,20 +42,31 @@ class GatewayApp:
     ``app["gateway"]``."""
 
     def __init__(self, settings: Settings, loader: ConfigLoader,
-                 local_factory: Callable[..., Provider] | None = None):
+                 local_factory: Callable[..., Provider] | None = None,
+                 metrics: GatewayMetrics | None = None,
+                 tracer: Tracer | None = None):
         self.settings = settings
         self.loader = loader
         self.usage_db = UsageDB(settings.db_dir or "db")
         self.rotation_db = RotationDB(settings.db_dir or "db")
         self.registry = ProviderRegistry(loader, local_factory=local_factory)
         self.breakers = BreakerRegistry(loader)
+        # Observability plane (ISSUE 4): the process-global metrics set by
+        # default (the local-provider factory records into it too) and a
+        # per-app trace ring buffer.
+        self.metrics = metrics or get_metrics()
+        self.tracer = tracer or Tracer()
         self.router = Router(
             loader, self.registry, self.rotation_db,
             fallback_provider=settings.fallback_provider,
             breakers=self.breakers,
-            default_timeout_ms=settings.default_request_timeout_ms)
+            default_timeout_ms=settings.default_request_timeout_ms,
+            metrics=self.metrics)
+        self._stats_collector = obs_api.make_stats_collector(self)
+        self.metrics.registry.register_collector(self._stats_collector)
 
     async def close(self) -> None:
+        self.metrics.registry.unregister_collector(self._stats_collector)
         await self.registry.close()
         self.usage_db.close()
         self.rotation_db.close()
@@ -91,12 +104,15 @@ def build_app(settings: Settings | None = None,
     app = web.Application(middlewares=[
         cors_middleware(settings.allowed_origins),
         request_id_header_middleware(),
-        request_logging_middleware(),
+        request_logging_middleware(metrics=gw.metrics, tracer=gw.tracer),
         auth_middleware(settings.gateway_api_key),
     ])
     app["gateway"] = gw
 
     app.router.add_get("/health", _health)
+    # Unified metrics plane: every layer's instruments in one Prometheus
+    # text-format scrape (ISSUE 4).
+    app.router.add_get("/metrics", obs_api.get_metrics_text)
     app.router.add_get("/", _root_redirect)
 
     # Core OpenAI-compatible API
@@ -125,6 +141,8 @@ def build_app(settings: Settings | None = None,
     app.router.add_get("/v1/api/engine-stats", profiler_api.get_engine_stats)
     app.router.add_get("/v1/api/roofline", profiler_api.get_roofline)
     app.router.add_post("/v1/api/profiler/trace", profiler_api.capture_trace)
+    # End-to-end request traces (router → provider → engine span trees).
+    app.router.add_get("/v1/api/trace/{request_id}", obs_api.get_trace)
 
     if STATIC_DIR.exists():
         app.router.add_static("/static", STATIC_DIR)

@@ -22,6 +22,7 @@ import math
 import json5
 from aiohttp import web
 
+from ..obs import trace as obs_trace
 from ..providers.base import JSONCompletion, StreamingCompletion
 from ..reliability.deadline import budget_ms_from_request
 from ..server.usage_capture import UsageCollector
@@ -54,13 +55,16 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
 
     outcome = await gw.router.dispatch(
         payload, client_api_key(request), observer_factory,
-        timeout_ms=timeout_ms)
+        timeout_ms=timeout_ms, request_id=request.get("request_id", ""))
 
     if outcome.error is not None or outcome.result is None:
         err = outcome.error
         detail = str(err) if err else "no providers succeeded"
         status = err.status if err and err.status in (429, 504) else 503
         headers = {}
+        timings = obs_trace.server_timing_header()
+        if timings:
+            headers["x-gateway-timings"] = timings
         if status == 429:
             # Numeric Retry-After (RFC 9110 delay-seconds) from the engine's
             # step-time/queue-wait telemetry or the breakers' cooldowns.
@@ -77,7 +81,14 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
 
     result = outcome.result
     if isinstance(result, JSONCompletion):
-        return web.json_response(result.data)
+        # Per-phase latency summary for the client (Server-Timing style).
+        # Non-streamed only: a streamed response's headers are on the wire
+        # before the phases being summarized have happened.
+        headers = {}
+        timings = obs_trace.server_timing_header()
+        if timings:
+            headers["x-gateway-timings"] = timings
+        return web.json_response(result.data, headers=headers)
 
     assert isinstance(result, StreamingCompletion)
     headers = {"Content-Type": "text/event-stream",
@@ -88,16 +99,21 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
     if request.get("request_id"):
         headers["x-request-id"] = request["request_id"]
     resp = web.StreamResponse(status=200, headers=headers)
+    # The on-wire status for the request-end log, should the stream die
+    # mid-flight (the middleware can't see it from a raised exception).
+    request["prepared_status"] = 200
     await resp.prepare(request)
-    try:
-        async for frame in result.frames:
-            await resp.write(frame)
-        await resp.write_eof()
-    except ConnectionResetError:
-        # Client hung up mid-stream; the provider generator's finally block
-        # still fires (usage gets recorded with what was streamed).
-        logger.info("client disconnected mid-stream")
-        await result.frames.aclose()
+    with obs_trace.span("gateway.stream_drain", layer="gateway"):
+        try:
+            async for frame in result.frames:
+                await resp.write(frame)
+            await resp.write_eof()
+        except ConnectionResetError:
+            # Client hung up mid-stream; the provider generator's finally
+            # block still fires (usage gets recorded with what was
+            # streamed).
+            logger.info("client disconnected mid-stream")
+            await result.frames.aclose()
     return resp
 
 

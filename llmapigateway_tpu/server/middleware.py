@@ -1,4 +1,4 @@
-"""aiohttp middlewares: CORS, request logging, bearer auth.
+"""aiohttp middlewares: CORS, request logging + observability, bearer auth.
 
 Parity targets:
 * CORS — reference wires CORSMiddleware with configured origins
@@ -11,20 +11,57 @@ Parity targets:
   (``middleware/auth.py:17`` — ``/chat/completion`` without the final "s");
   here the **intended** behavior is implemented: all ``/v1/*`` endpoints are
   protected except health; open when no key is configured (``auth.py:37-42``).
+
+Observability (ISSUE 4): the logging middleware is also the HTTP layer's
+instrumentation point — it owns the request id (honoring a valid
+client-supplied ``x-request-id``), opens the request's root trace span,
+and records the ``gateway_http_*`` metrics (in-flight, duration by route
+template, completions by status).
 """
 from __future__ import annotations
 
 import logging
+import re
 import time
 import uuid
 
 from aiohttp import web
 
+from ..obs import metrics as obs_metrics
+from ..obs import trace as obs_trace
 from ..utils.logging_setup import mask_headers
 
 logger = logging.getLogger("gateway.request")
 
-UNPROTECTED_PATHS = frozenset(("/health", "/", "/favicon.ico"))
+UNPROTECTED_PATHS = frozenset(("/health", "/metrics", "/", "/favicon.ico"))
+
+# Paths excluded from per-request logging/metrics/tracing: health probes
+# and the metrics scrape itself poll every few seconds — logging them
+# drowns the signal, and a scrape-counts-scrapes loop helps nobody.
+UNOBSERVED_PATHS = frozenset(("/health", "/metrics"))
+
+# A client-supplied x-request-id is honored only in this shape; anything
+# else (too long, exotic characters that would corrupt logs or upstream
+# headers) falls back to a generated id.
+_REQUEST_ID_RE = re.compile(r"[A-Za-z0-9_-]{1,64}")
+
+
+def resolve_request_id(request: web.Request) -> str:
+    supplied = request.headers.get("x-request-id", "")
+    if supplied and _REQUEST_ID_RE.fullmatch(supplied):
+        return supplied
+    return uuid.uuid4().hex[:16]
+
+
+def _route_template(request: web.Request) -> str:
+    """The matched route's template (``/v1/api/trace/{request_id}``) — a
+    bounded metrics label, unlike the raw path."""
+    try:
+        resource = request.match_info.route.resource
+        canonical = resource.canonical if resource is not None else None
+    except AttributeError:
+        canonical = None
+    return canonical or "unmatched"
 
 
 def cors_middleware(allowed_origins: list[str]):
@@ -80,14 +117,22 @@ def _redacted_payload(raw: bytes) -> dict | None:
     return payload
 
 
-def request_logging_middleware():
+def request_logging_middleware(metrics: "obs_metrics.GatewayMetrics | None" = None,
+                               tracer: "obs_trace.Tracer | None" = None,
+                               clock=time.monotonic):
+    """Request logging + the HTTP layer's metrics and trace root.
+
+    ``metrics``/``tracer`` default to None (pure logging) so existing
+    embedders keep working; server/app.py passes the gateway's instances.
+    """
     @web.middleware
     async def middleware(request: web.Request, handler):
-        if request.path == "/health":
+        if request.path in UNOBSERVED_PATHS:
             return await handler(request)
-        req_id = uuid.uuid4().hex[:16]
+        req_id = resolve_request_id(request)
         request["request_id"] = req_id
-        start = time.monotonic()
+        start = clock()
+        route = _route_template(request)
         log_extra = {
             "request_id": req_id, "method": request.method,
             "path": request.path, "client": request.remote,
@@ -99,7 +144,18 @@ def request_logging_middleware():
             if payload is not None:
                 log_extra["payload"] = payload
         logger.info("request start", extra=log_extra)
+        if metrics is not None:
+            metrics.http_in_flight.inc()
+        stream_error = False
         try:
+            if tracer is not None:
+                with tracer.trace(req_id) as tr:
+                    tr.root.attrs["method"] = request.method
+                    tr.root.attrs["path"] = request.path
+                    resp = await handler(request)
+                    status = resp.status
+                    tr.root.attrs["status"] = status
+                    return resp
             resp = await handler(request)
             status = resp.status
             return resp
@@ -107,13 +163,32 @@ def request_logging_middleware():
             status = e.status
             raise
         except Exception:
-            status = 500
+            # A streaming handler that raised after committing already put
+            # its status on the wire — record what's known (the prepared
+            # status + the fact the stream died), not a fictitious 500.
+            prepared = request.get("prepared_status")
+            stream_error = prepared is not None
+            status = prepared if prepared is not None else 500
             raise
         finally:
-            duration_ms = (time.monotonic() - start) * 1000.0
-            logger.info("request end", extra={
-                "request_id": req_id, "status": status,
-                "duration_ms": round(duration_ms, 2)})
+            duration_s = clock() - start
+            # End lines must be greppable on their own: method/path ride
+            # along with the status (ISSUE 4 satellite — previously only
+            # request_id/status/duration).
+            end_extra = {
+                "request_id": req_id, "method": request.method,
+                "path": request.path, "status": status,
+                "duration_ms": round(duration_s * 1000.0, 2)}
+            if stream_error:
+                end_extra["stream_error"] = True
+            logger.info("request end", extra=end_extra)
+            if metrics is not None:
+                metrics.http_in_flight.dec()
+                metrics.http_requests_total.labels(
+                    method=request.method, path=route,
+                    status=str(status)).inc()
+                metrics.http_request_duration_seconds.labels(
+                    method=request.method, path=route).observe(duration_s)
 
     return middleware
 

@@ -1,0 +1,100 @@
+"""Unified observability endpoints (ISSUE 4).
+
+* ``GET /metrics`` — the whole gateway in Prometheus text format: HTTP
+  middleware, router, providers (incl. breaker state), and engine series
+  in one scrape. Unauthenticated (like ``/health``): scrapers cannot
+  attach bearer headers, and nothing here carries payload data.
+* ``GET /v1/api/trace/{request_id}`` — one request's span tree from the
+  tracer's ring buffer (gateway → router attempt N → provider call →
+  engine phases). Flatten with ``tools/trace_report.py``.
+
+The engine/breaker bridge lives here too: a scrape-time collector maps
+each instantiated local engine's existing ``stats()`` dict (and each
+breaker's snapshot) onto gauges — the roofline endpoint, bench, and
+health endpoint keep reading the same sources unchanged.
+"""
+from __future__ import annotations
+
+import logging
+
+from aiohttp import web
+
+from ..obs.metrics import GatewayMetrics
+
+logger = logging.getLogger(__name__)
+
+# stats() key → GatewayMetrics attribute (plus a unit transform).
+_ENGINE_GAUGES = (
+    # (stats key, metrics attr, scale)
+    ("running", "engine_running_requests_total", 1.0),
+    ("queued", "engine_queued_requests_total", 1.0),
+    ("free_slots", "engine_free_slots_total", 1.0),
+    ("shed_total", "engine_sheds_total", 1.0),
+    ("burst_busy_clamps", "engine_burst_clamps_total", 1.0),
+    ("free_pages", "engine_kv_free_pages_total", 1.0),
+    ("hbm_bytes_per_step", "engine_step_hbm_bytes", 1.0),
+    ("roofline_fraction", "engine_roofline_ratio", 1.0),
+    ("queue_wait_ms_ema", "engine_queue_wait_seconds", 1e-3),
+    ("decode_ms_per_step", "engine_decode_step_seconds", 1e-3),
+    ("achieved_gbps", "engine_hbm_bandwidth_bytes", 1e9),
+)
+
+
+def make_stats_collector(gw) -> "callable":
+    """The scrape-time bridge from pull-model telemetry (engine ``stats()``
+    dicts, breaker snapshots) into the metrics plane. Registered by
+    GatewayApp; unregistered on close so test apps don't stack up."""
+    metrics: GatewayMetrics = gw.metrics
+
+    def collect() -> None:
+        for name, prov in gw.registry.instantiated():
+            engine = getattr(prov, "engine", None)
+            if engine is None:
+                continue
+            try:
+                stats = engine.stats()
+            except Exception:
+                logger.debug("engine stats() failed for %s", name,
+                             exc_info=True)
+                continue
+            for key, attr, scale in _ENGINE_GAUGES:
+                val = stats.get(key)
+                if isinstance(val, (int, float)):
+                    getattr(metrics, attr).labels(engine=name).set(
+                        val * scale)
+            total = stats.get("total_pages")
+            free = stats.get("free_pages")
+            if isinstance(total, (int, float)) and total > 0 \
+                    and isinstance(free, (int, float)):
+                metrics.engine_kv_occupancy_ratio.labels(engine=name).set(
+                    max(0.0, 1.0 - free / total))
+        if gw.breakers is not None:
+            for name, snap in gw.breakers.snapshot().items():
+                metrics.provider_breaker_open_ratio.labels(
+                    provider=name).set(snap.get("state_code", 0.0))
+                metrics.provider_breaker_opens_total.labels(
+                    provider=name).set(snap.get("opens", 0))
+
+    return collect
+
+
+async def get_metrics_text(request: web.Request) -> web.Response:
+    gw = request.app["gateway"]
+    text = gw.metrics.render()
+    return web.Response(
+        text=text,
+        headers={"Content-Type":
+                 "text/plain; version=0.0.4; charset=utf-8"})
+
+
+async def get_trace(request: web.Request) -> web.Response:
+    gw = request.app["gateway"]
+    request_id = request.match_info["request_id"]
+    doc = gw.tracer.get(request_id)
+    if doc is None:
+        return web.json_response(
+            {"detail": f"no trace for request id {request_id!r} (ring "
+                       f"buffer holds the most recent "
+                       f"{gw.tracer.capacity} requests)"},
+            status=404)
+    return web.json_response(doc)

@@ -59,7 +59,8 @@ async def get_provider_health(request: web.Request) -> web.Response:
     providers = {}
     for name, details in sorted(gw.loader.providers.items()):
         entry = snapshot.pop(name, None) or {
-            "state": "closed", "failure_rate": 0.0, "window_requests": 0,
+            "state": "closed", "state_code": 0.0,
+            "failure_rate": 0.0, "window_requests": 0,
             "cooldown_remaining_s": 0.0, "opens": 0, "last_transition": None,
             "enabled": (details.breaker.enabled
                         if details.breaker is not None else True),

@@ -45,6 +45,10 @@ class FaultPlan:
     omit_usage: bool = False
     delay_s: float = 0.0               # slow headers: sleep before responding
     stream_delay_s: float = 0.0        # per-frame sleep while streaming
+    # Healthy frames, then ONE long stall (the mid-stream hang case: the
+    # gateway's deadline-capped read timeout must fire while streaming).
+    stall_after_frames: int | None = None
+    stall_s: float = 0.0
     tokens: list[str] = field(default_factory=lambda: ["Hello", " ", "world", "!"])
 
 
@@ -136,6 +140,9 @@ class FakeUpstream:
                 # client a well-formed SSE error frame (chaos satellite).
                 request.transport.abort()
                 return resp
+            if plan.stall_after_frames is not None \
+                    and i == plan.stall_after_frames:
+                await asyncio.sleep(plan.stall_s)
             if plan.stream_delay_s:
                 await asyncio.sleep(plan.stream_delay_s)
             await send(self._chunk(i, tok, model))

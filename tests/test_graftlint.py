@@ -339,6 +339,55 @@ def test_timeout_discipline_scoped_to_providers():
                                                  "server/fixture.py")
 
 
+METRIC_BAD = """
+    def setup(registry, tracer):
+        registry.counter("Gateway_Requests_Total", "not snake_case")
+        registry.gauge("gateway_queue_depth", "no unit suffix")
+        registry.histogram("gateway_latency_ms", "wrong unit suffix")
+        sp = begin_span("router.attempt", layer="router")
+        sp2 = tracer.begin_span("provider.call")
+"""
+
+METRIC_GOOD = """
+    def setup(registry):
+        registry.counter("gateway_http_requests_total", "completions")
+        registry.gauge("gateway_engine_queue_wait_seconds", "admission wait")
+        registry.histogram("gateway_provider_attempt_duration_seconds", "rt")
+        registry.gauge("gateway_engine_kv_occupancy_ratio", "pool use")
+        registry.gauge("gateway_engine_step_hbm_bytes", "bytes/step")
+        registry.counter(dynamic_name, "non-literal name: not checkable")
+        with span("router.attempt", layer="router"):
+            pass
+        payload.get("model")            # unrelated .get: not a factory
+"""
+
+
+def test_metric_discipline_fires_on_bad():
+    findings = lint(METRIC_BAD, "server/fixture.py")
+    assert {f.rule for f in findings} == {"metric-discipline"}
+    msgs = " | ".join(f.message for f in findings)
+    assert "not snake_case" in msgs
+    assert "lacks a unit suffix" in msgs
+    assert "begin_span" in msgs
+    # 3 bad names + 2 bare begin_span calls (bare and method form).
+    assert len(findings) == 5
+
+
+def test_metric_discipline_silent_on_good():
+    assert rules_hit(METRIC_GOOD, "server/fixture.py") == set()
+
+
+def test_metric_discipline_exempts_the_tracer_module():
+    src = """
+    def span(name, layer="gateway", **attrs):
+        sp = begin_span(name, layer, **attrs)
+        return sp
+    """
+    assert "metric-discipline" not in rules_hit(src, "obs/trace.py")
+    # The same primitive call anywhere else is a finding.
+    assert "metric-discipline" in rules_hit(src, "obs/other.py")
+
+
 # -- suppressions -------------------------------------------------------------
 
 def test_trailing_suppression_is_line_scoped():

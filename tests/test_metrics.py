@@ -1,0 +1,274 @@
+"""obs/metrics.py: the dependency-free registry and its Prometheus
+text-format exposition, validated against the text-format grammar
+(HELP/TYPE pairing, label escaping, histogram _bucket/_sum/_count
+consistency, monotone cumulative buckets) — the validator here is also what
+the integration test runs over the live ``/metrics`` endpoint."""
+import math
+import re
+
+import pytest
+
+from llmapigateway_tpu.obs.metrics import (
+    GatewayMetrics,
+    LATENCY_BUCKETS_S,
+    MetricsRegistry,
+)
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*\Z")
+_LABEL_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*\Z")
+_VALUE_RE = re.compile(r"(?:[+-]?Inf|NaN|-?[0-9]*\.?[0-9]+(?:[eE][+-]?[0-9]+)?)\Z")
+
+
+def _parse_labels(body: str) -> dict:
+    """Parse the {k="v",...} body honoring \\" escapes."""
+    labels = {}
+    i = 0
+    while i < len(body):
+        eq = body.index("=", i)
+        name = body[i:eq]
+        assert _LABEL_NAME_RE.fullmatch(name), f"bad label name {name!r}"
+        assert body[eq + 1] == '"', "label value must be quoted"
+        j = eq + 2
+        val = []
+        while True:
+            ch = body[j]
+            if ch == "\\":
+                esc = body[j + 1]
+                assert esc in ('"', "\\", "n"), f"bad escape \\{esc}"
+                val.append({"n": "\n"}.get(esc, esc))
+                j += 2
+            elif ch == '"':
+                break
+            else:
+                assert ch != "\n", "raw newline in label value"
+                val.append(ch)
+                j += 1
+        labels[name] = "".join(val)
+        i = j + 1
+        if i < len(body):
+            assert body[i] == ",", "labels must be comma-separated"
+            i += 1
+    return labels
+
+
+def parse_sample(line: str):
+    """One sample line -> (name, labels dict, float value)."""
+    if "{" in line:
+        name, rest = line.split("{", 1)
+        body, rest = rest.rsplit("}", 1)
+        labels = _parse_labels(body)
+        value_str = rest.strip()
+    else:
+        name, value_str = line.split(None, 1)
+        labels = {}
+    assert _NAME_RE.fullmatch(name), f"bad metric name {name!r}"
+    assert _VALUE_RE.fullmatch(value_str.strip()), \
+        f"bad sample value {value_str!r}"
+    return name, labels, float(value_str.replace("Inf", "inf"))
+
+
+def validate_prometheus_text(text: str) -> dict:
+    """Assert ``text`` is grammatical Prometheus 0.0.4 exposition; returns
+    {family name: {"type": ..., "samples": [(name, labels, value), ...]}}.
+
+    Checks: every family has exactly one HELP and one TYPE (HELP before
+    TYPE before samples); every sample belongs to the family whose block
+    it is in (histograms: only _bucket/_sum/_count); label syntax and
+    escaping; histogram consistency — per labelset the cumulative buckets
+    are monotone, the +Inf bucket equals _count, and _sum/_count exist.
+    """
+    assert text.endswith("\n"), "exposition must end with a newline"
+    families: dict[str, dict] = {}
+    current = None
+    for line in text.splitlines():
+        assert line == line.strip(), f"stray whitespace: {line!r}"
+        assert line, "blank line in exposition"
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            fam = rest.split(" ", 1)[0]
+            assert fam not in families, f"duplicate HELP for {fam}"
+            families[fam] = {"type": None, "samples": [], "help": True}
+            current = fam
+        elif line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            fam, kind = rest.split(" ", 1)
+            assert fam == current, f"TYPE {fam} outside its HELP block"
+            assert families[fam]["type"] is None, f"duplicate TYPE for {fam}"
+            assert kind in ("counter", "gauge", "histogram", "summary",
+                            "untyped"), kind
+            families[fam]["type"] = kind
+        elif line.startswith("#"):
+            continue                            # comment — legal
+        else:
+            name, labels, value = parse_sample(line)
+            assert current is not None, f"sample before any family: {line!r}"
+            fam = families[current]
+            assert fam["type"] is not None, f"sample before TYPE: {line!r}"
+            if fam["type"] == "histogram":
+                assert name in (f"{current}_bucket", f"{current}_sum",
+                                f"{current}_count"), \
+                    f"{name} not a histogram series of {current}"
+                if name.endswith("_bucket"):
+                    assert "le" in labels, "_bucket without le label"
+            else:
+                assert name == current, \
+                    f"sample {name} inside family block {current}"
+            fam["samples"].append((name, labels, value))
+
+    # Histogram consistency per labelset.
+    for fam_name, fam in families.items():
+        if fam["type"] != "histogram":
+            continue
+        by_key: dict[tuple, dict] = {}
+        for name, labels, value in fam["samples"]:
+            key = tuple(sorted((k, v) for k, v in labels.items()
+                               if k != "le"))
+            entry = by_key.setdefault(key, {"buckets": [], "sum": None,
+                                            "count": None})
+            if name.endswith("_bucket"):
+                le = labels["le"]
+                entry["buckets"].append(
+                    (math.inf if le == "+Inf" else float(le), value))
+            elif name.endswith("_sum"):
+                entry["sum"] = value
+            else:
+                entry["count"] = value
+        for key, entry in by_key.items():
+            assert entry["sum"] is not None, f"{fam_name}{key}: no _sum"
+            assert entry["count"] is not None, f"{fam_name}{key}: no _count"
+            buckets = sorted(entry["buckets"])
+            assert buckets, f"{fam_name}{key}: no buckets"
+            assert buckets[-1][0] == math.inf, f"{fam_name}{key}: no +Inf"
+            counts = [n for _, n in buckets]
+            assert counts == sorted(counts), \
+                f"{fam_name}{key}: buckets not monotone: {counts}"
+            assert counts[-1] == entry["count"], \
+                f"{fam_name}{key}: +Inf bucket != _count"
+    return families
+
+
+# -- instruments --------------------------------------------------------------
+
+def test_counter_and_labels():
+    reg = MetricsRegistry()
+    c = reg.counter("x_total", "help", ("provider",))
+    c.labels(provider="a").inc()
+    c.labels(provider="a").inc(2)
+    c.labels(provider="b").inc()
+    fams = validate_prometheus_text(reg.render())
+    samples = {tuple(l.items()): v for _, l, v in fams["x_total"]["samples"]}
+    assert samples[(("provider", "a"),)] == 3
+    assert samples[(("provider", "b"),)] == 1
+    with pytest.raises(ValueError):
+        c.labels(provider="a").inc(-1)          # counters only go up
+    with pytest.raises(ValueError):
+        c.labels(wrong="a")                     # label schema enforced
+
+
+def test_gauge_set_and_dec():
+    reg = MetricsRegistry()
+    g = reg.gauge("g_total", "help")
+    g.inc(); g.inc(); g.dec()
+    assert "g_total 1" in reg.render()
+    g.set(7.5)
+    assert "g_total 7.5" in reg.render()
+
+
+def test_histogram_buckets_sum_count():
+    reg = MetricsRegistry()
+    h = reg.histogram("h_seconds", "help", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.5, 0.5, 5.0, 50.0):
+        h.observe(v)
+    fams = validate_prometheus_text(reg.render())
+    samples = fams["h_seconds"]["samples"]
+    by_name = {}
+    for name, labels, value in samples:
+        by_name.setdefault(name, []).append((labels, value))
+    buckets = {l["le"]: v for l, v in by_name["h_seconds_bucket"]}
+    assert buckets == {"0.1": 1, "1": 3, "10": 4, "+Inf": 5}
+    assert by_name["h_seconds_count"][0][1] == 5
+    assert by_name["h_seconds_sum"][0][1] == pytest.approx(56.05)
+
+
+def test_registration_is_idempotent_but_type_safe():
+    reg = MetricsRegistry()
+    a = reg.counter("a_total", "help", ("x",))
+    assert reg.counter("a_total", "other help", ("x",)) is a
+    with pytest.raises(ValueError):
+        reg.gauge("a_total", "help", ("x",))
+    with pytest.raises(ValueError):
+        reg.counter("a_total", "help", ("y",))
+
+
+def test_label_escaping_round_trips():
+    reg = MetricsRegistry()
+    c = reg.counter("esc_total", "he\\lp\nline", ("path",))
+    nasty = 'a"b\\c\nd'
+    c.labels(path=nasty).inc()
+    text = reg.render()
+    fams = validate_prometheus_text(text)
+    (_, labels, value), = fams["esc_total"]["samples"]
+    assert labels["path"] == nasty
+    assert value == 1
+
+
+def test_collectors_run_at_render_and_failures_are_contained():
+    reg = MetricsRegistry()
+    g = reg.gauge("pull_total", "bridged")
+    calls = []
+
+    def ok_collector():
+        calls.append(1)
+        g.set(len(calls))
+
+    def broken_collector():
+        raise RuntimeError("sick engine")
+
+    reg.register_collector(ok_collector)
+    reg.register_collector(broken_collector)
+    assert "pull_total 1" in reg.render()
+    assert "pull_total 2" in reg.render()      # runs per scrape
+    reg.unregister_collector(ok_collector)
+    assert "pull_total 2" in reg.render()      # stale value, no new run
+
+
+def test_gateway_metrics_schema_is_lint_clean_and_renders():
+    """Every pre-registered instrument name obeys the metric-discipline
+    convention, and the empty registry renders grammatically (HELP/TYPE
+    for the full schema from first scrape)."""
+    gm = GatewayMetrics()
+    fams = validate_prometheus_text(gm.render())
+    assert len(fams) >= 25
+    for name in fams:
+        assert re.fullmatch(r"[a-z][a-z0-9_]*", name), name
+        assert name.endswith(("_seconds", "_bytes", "_total", "_ratio")), name
+    # All four layers are represented in the schema.
+    for prefix in ("gateway_http_", "gateway_router_", "gateway_provider_",
+                   "gateway_engine_"):
+        assert any(n.startswith(prefix) for n in fams), prefix
+
+
+def test_durations_under_fake_clock():
+    """Exposition consistency with deterministic durations: drive a
+    histogram with a fake clock exactly as the middleware does."""
+    reg = MetricsRegistry()
+    h = reg.histogram("d_seconds", "help", ("path",),
+                      buckets=LATENCY_BUCKETS_S)
+    t = [100.0]
+
+    def clock():
+        return t[0]
+
+    start = clock()
+    t[0] += 0.042
+    h.labels(path="/x").observe(clock() - start)
+    fams = validate_prometheus_text(reg.render())
+    by_name = {}
+    for name, labels, value in fams["d_seconds"]["samples"]:
+        by_name.setdefault(name, []).append((labels, value))
+    assert by_name["d_seconds_sum"][0][1] == pytest.approx(0.042)
+    # 0.042 lands in the 0.05 bucket and every coarser one.
+    for labels, value in by_name["d_seconds_bucket"]:
+        expected = 1 if (labels["le"] == "+Inf"
+                         or float(labels["le"]) >= 0.05) else 0
+        assert value == expected, labels

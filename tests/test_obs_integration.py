@@ -1,0 +1,400 @@
+"""ISSUE 4 acceptance: the unified metrics plane and end-to-end request
+tracing, over the real aiohttp app with a real (tiny, CPU) local engine
+plus fake remote upstreams.
+
+* ``GET /metrics`` serves grammatical Prometheus text covering ≥ 25
+  distinct series spanning all four layers (http, router, provider,
+  engine), validated by the grammar checker from tests/test_metrics.py.
+* ``GET /v1/api/trace/{request_id}`` returns a complete span tree —
+  router attempt → provider call → engine phases, including a fallback
+  hop — for a streamed local-engine request AND a remote-provider
+  request; ``x-gateway-timings`` summarizes non-streamed responses and
+  the request id propagates upstream.
+* Chaos: a deadline expiring mid-stream leaves no leaked (unclosed)
+  spans.
+"""
+import json
+
+import jax
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from llmapigateway_tpu.config.loader import ConfigLoader
+from llmapigateway_tpu.config.schemas import ProviderDetails
+from llmapigateway_tpu.config.settings import Settings
+from llmapigateway_tpu.providers.local import LocalProvider
+from llmapigateway_tpu.server.app import GatewayApp, build_app
+from tests.fake_upstream import FakeUpstream
+from tests.test_metrics import validate_prometheus_text
+
+
+@pytest.fixture(scope="module")
+def local_factory():
+    """Build the tiny CPU engine once per module (compile cache)."""
+    cache = {}
+
+    def factory(name: str, details: ProviderDetails) -> LocalProvider:
+        if name not in cache:
+            from llmapigateway_tpu.engine.engine import InferenceEngine
+            cache[name] = InferenceEngine(details.engine,
+                                          devices=[jax.devices("cpu")[0]])
+        return LocalProvider(name, cache[name])
+
+    factory.engines = cache
+    return factory
+
+
+class ObsGateway:
+    """Gateway wired to one flaky remote, one healthy backup remote, and
+    the tiny local engine — enough topology for fallback-hop traces."""
+
+    def __init__(self, tmp_path, local_factory):
+        self.tmp_path = tmp_path
+        self.local_factory = local_factory
+
+    async def __aenter__(self):
+        self.flaky = FakeUpstream()
+        self.backup = FakeUpstream()
+        self.servers = []
+        urls = []
+        for up in (self.flaky, self.backup):
+            server = TestServer(up.app)
+            await server.start_server()
+            self.servers.append(server)
+            urls.append(f"http://{server.host}:{server.port}/v1")
+        providers = [
+            {"flaky": {"baseUrl": urls[0], "apikey": "FLK"}},
+            {"backup": {"baseUrl": urls[1], "apikey": "BK"}},
+            {"tpu": {"type": "local",
+                     "engine": {"preset": "tiny-test", "dtype": "float32",
+                                "max_batch_size": 2, "max_seq_len": 128,
+                                "prefill_chunk": 32, "decode_burst": 4,
+                                "max_tokens_default": 8}}},
+        ]
+        rules = [
+            {"gateway_model_name": "gw/local",
+             "fallback_models": [
+                 {"provider": "flaky", "model": "real-a", "retry_count": 0},
+                 {"provider": "tpu", "model": "tiny-test"}]},
+            {"gateway_model_name": "gw/remote",
+             "fallback_models": [
+                 {"provider": "flaky", "model": "real-a", "retry_count": 0},
+                 {"provider": "backup", "model": "real-b"}]},
+            {"gateway_model_name": "gw/local-direct",
+             "fallback_models": [
+                 {"provider": "tpu", "model": "tiny-test"}]},
+        ]
+        (self.tmp_path / "providers.json").write_text(json.dumps(providers))
+        (self.tmp_path / "models_fallback_rules.json").write_text(
+            json.dumps(rules))
+        settings = Settings(fallback_provider="backup",
+                            base_dir=self.tmp_path,
+                            config_dir=self.tmp_path,
+                            db_dir=self.tmp_path / "db",
+                            logs_dir=self.tmp_path / "logs")
+        loader = ConfigLoader(self.tmp_path, fallback_provider=None)
+        self.gw = GatewayApp(settings, loader,
+                             local_factory=self.local_factory)
+        app = build_app(settings, loader, gateway=self.gw)
+        self.client = TestClient(TestServer(app))
+        await self.client.start_server()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.client.close()
+        for s in self.servers:
+            await s.close()
+
+
+async def read_sse_frames(resp):
+    frames = []
+    async for line in resp.content:
+        line = line.decode().strip()
+        if line.startswith("data: "):
+            frames.append(line[len("data: "):])
+    return frames
+
+
+def walk_spans(span):
+    yield span
+    for child in span.get("children", ()):
+        yield from walk_spans(child)
+
+
+def assert_all_closed(doc):
+    open_spans = [s["name"] for s in walk_spans(doc["spans"])
+                  if s["duration_ms"] is None]
+    assert not open_spans, f"leaked (unclosed) spans: {open_spans}"
+
+
+# -- trace trees --------------------------------------------------------------
+
+async def test_streamed_local_trace_with_fallback_hop(tmp_path,
+                                                      local_factory):
+    async with ObsGateway(tmp_path, local_factory) as g:
+        g.flaky.plan.fail_next = 1
+        resp = await g.client.post(
+            "/v1/chat/completions",
+            json={"model": "gw/local", "stream": True, "max_tokens": 6,
+                  "messages": [{"role": "user", "content": "hi"}]},
+            headers={"x-request-id": "trace-local-1"})
+        assert resp.status == 200
+        assert resp.headers["x-request-id"] == "trace-local-1"
+        frames = await read_sse_frames(resp)
+        assert frames[-1] == "[DONE]"
+
+        resp = await g.client.get("/v1/api/trace/trace-local-1")
+        assert resp.status == 200
+        doc = await resp.json()
+        assert doc["request_id"] == "trace-local-1"
+        assert doc["complete"] is True
+        assert_all_closed(doc)
+
+        root = doc["spans"]
+        assert root["layer"] == "gateway"
+        attempts = [s for s in root["children"]
+                    if s["name"] == "router.attempt"]
+        # The fallback hop: failed flaky attempt, then the local engine.
+        assert [a["attrs"]["provider"] for a in attempts] == ["flaky", "tpu"]
+        assert "error" in attempts[0]["attrs"]
+        (call,) = [s for s in attempts[1]["children"]
+                   if s["name"] == "provider.call"]
+        assert call["layer"] == "provider"
+        engine_phases = {s["name"] for s in call.get("children", ())}
+        assert {"engine.queued", "engine.prefill", "engine.first_token",
+                "engine.decode"} <= engine_phases
+        # The stream drain is traced at the gateway layer.
+        assert any(s["name"] == "gateway.stream_drain"
+                   for s in root["children"])
+        # Engine phases nest in causal order.
+        by_name = {s["name"]: s for s in call["children"]}
+        assert (by_name["engine.queued"]["start_ms"]
+                <= by_name["engine.prefill"]["start_ms"]
+                <= by_name["engine.decode"]["start_ms"])
+
+
+async def test_remote_trace_timings_header_and_id_propagation(tmp_path,
+                                                              local_factory):
+    async with ObsGateway(tmp_path, local_factory) as g:
+        g.flaky.plan.fail_next = 1
+        resp = await g.client.post(
+            "/v1/chat/completions",
+            json={"model": "gw/remote",
+                  "messages": [{"role": "user", "content": "hi"}]},
+            headers={"x-request-id": "trace-remote-1"})
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["choices"][0]["message"]["content"] == "Hello world!"
+
+        # Satellite: the gateway's request id propagated upstream on BOTH
+        # attempts of the fallback chain.
+        assert g.flaky.headers_seen[0].get("x-request-id") == "trace-remote-1"
+        assert g.backup.headers_seen[0].get("x-request-id") == "trace-remote-1"
+
+        # Non-streamed responses summarize per-phase latency.
+        timings = resp.headers["x-gateway-timings"]
+        assert "total;dur=" in timings
+        assert "router_attempt;dur=" in timings
+        assert "provider_call;dur=" in timings
+
+        resp = await g.client.get("/v1/api/trace/trace-remote-1")
+        doc = await resp.json()
+        assert doc["complete"] is True
+        assert_all_closed(doc)
+        attempts = [s for s in doc["spans"]["children"]
+                    if s["name"] == "router.attempt"]
+        assert [a["attrs"]["provider"] for a in attempts] == ["flaky",
+                                                              "backup"]
+        assert all(any(c["name"] == "provider.call"
+                       for c in a["children"]) for a in attempts)
+
+
+async def test_trace_endpoint_404_for_unknown_id(tmp_path, local_factory):
+    async with ObsGateway(tmp_path, local_factory) as g:
+        resp = await g.client.get("/v1/api/trace/no-such-request")
+        assert resp.status == 404
+        assert "ring buffer" in (await resp.json())["detail"]
+
+
+# -- the metrics plane --------------------------------------------------------
+
+async def test_metrics_exposition_grammar_and_layer_coverage(tmp_path,
+                                                             local_factory):
+    """The acceptance bar: one scrape, valid grammar, ≥ 25 distinct series
+    spanning http, router, provider, and engine."""
+    async with ObsGateway(tmp_path, local_factory) as g:
+        # Traffic across all layers: a local streamed request (engine), a
+        # remote fallback (router fallbacks + provider errors), and a 404.
+        g.flaky.plan.fail_next = 2
+        resp = await g.client.post(
+            "/v1/chat/completions",
+            json={"model": "gw/local", "stream": True, "max_tokens": 4,
+                  "messages": [{"role": "user", "content": "hi"}]})
+        await read_sse_frames(resp)
+        resp = await g.client.post(
+            "/v1/chat/completions",
+            json={"model": "gw/remote", "messages": []})
+        assert resp.status == 200
+        await g.client.get("/v1/does-not-exist")
+
+        resp = await g.client.get("/metrics")
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/plain")
+        text = await resp.text()
+
+    families = validate_prometheus_text(text)
+
+    # Every family obeys the naming convention the lint pins.
+    for name in families:
+        assert name.endswith(("_seconds", "_bytes", "_total", "_ratio")), name
+
+    series = set()
+    for fam in families.values():
+        for name, labels, _ in fam["samples"]:
+            series.add((name, tuple(sorted(labels.items()))))
+    assert len(series) >= 25, f"only {len(series)} series"
+
+    # All four layers report actual samples, not just HELP/TYPE.
+    for prefix in ("gateway_http_", "gateway_router_", "gateway_provider_",
+                   "gateway_engine_"):
+        assert any(n.startswith(prefix) for n, _ in series), prefix
+
+    def sample_value(fam, sample=None, **labels):
+        for name, got, value in families[fam]["samples"]:
+            if sample is not None and name != sample:
+                continue
+            if all(got.get(k) == v for k, v in labels.items()):
+                return value
+        return None
+
+    # Spot checks across the layers.
+    assert sample_value("gateway_router_attempts_total",
+                        provider="tpu") >= 1
+    assert sample_value("gateway_router_attempts_total",
+                        provider="flaky") >= 2
+    assert sample_value("gateway_provider_errors_total",
+                        provider="flaky", kind="http") >= 2
+    assert families["gateway_router_fallbacks_total"]["samples"][0][2] >= 2
+    assert sample_value("gateway_engine_running_requests_total",
+                        engine="tpu") is not None
+    assert sample_value("gateway_engine_ttft_seconds",
+                        sample="gateway_engine_ttft_seconds_count",
+                        engine="tpu") >= 1
+    assert sample_value("gateway_provider_breaker_open_ratio",
+                        provider="flaky") == 0.0
+    # The chat route label is the route template, status-split.
+    assert sample_value("gateway_http_requests_total",
+                        path="/v1/chat/completions", status="200") >= 2
+
+
+async def test_metrics_endpoint_is_unauthenticated_and_unlogged(
+        tmp_path, local_factory, caplog):
+    import logging
+    async with ObsGateway(tmp_path, local_factory) as g:
+        g.gw.settings.gateway_api_key = "sekret"     # not used by build_app
+        with caplog.at_level(logging.INFO, logger="gateway.request"):
+            resp = await g.client.get("/metrics")
+            assert resp.status == 200
+    assert not any("GET /metrics" in r.getMessage() for r in caplog.records)
+    assert not any(getattr(r, "path", "") == "/metrics"
+                   for r in caplog.records)
+
+
+# -- chaos: deadline mid-stream ----------------------------------------------
+
+async def test_deadline_mid_stream_closes_all_spans(tmp_path, local_factory):
+    """The request's budget expires while a committed upstream stream is
+    being relayed (the upstream stalls past the deadline-capped read
+    timeout): the client's 200 stream ends with an in-band error frame and
+    — the acceptance bar — the trace holds no leaked (unclosed) spans."""
+    async with ObsGateway(tmp_path, local_factory) as g:
+        # The chain's first target serves healthy priming frames, then
+        # stalls far past the 400 ms budget.
+        g.flaky.plan.stall_after_frames = 2
+        g.flaky.plan.stall_s = 5.0
+        resp = await g.client.post(
+            "/v1/chat/completions",
+            json={"model": "gw/remote", "stream": True,
+                  "messages": [{"role": "user", "content": "go"}]},
+            headers={"x-request-id": "chaos-deadline-1",
+                     "x-request-timeout-ms": "400"})
+        assert resp.status == 200              # committed before expiry
+        frames = await read_sse_frames(resp)
+        last = json.loads(frames[-1])
+        assert "error" in last
+
+        resp = await g.client.get("/v1/api/trace/chaos-deadline-1")
+        doc = await resp.json()
+        assert doc["complete"] is True
+        assert_all_closed(doc)
+        names = {s["name"] for s in walk_spans(doc["spans"])}
+        assert "gateway.stream_drain" in names
+        assert "provider.call" in names
+
+
+async def test_local_deadline_mid_stream_cancels_and_closes_spans():
+    """The local engine's streamed path under a mid-stream deadline expiry,
+    driven deterministically with a fake clock at the provider layer: the
+    stream ends with an in-band 504 error frame, the engine request is
+    cancelled (slot frees), and every recorded span is closed."""
+    from llmapigateway_tpu.obs import trace as obs_trace
+    from llmapigateway_tpu.obs.trace import Tracer
+    from llmapigateway_tpu.providers.local import LocalProvider
+    from llmapigateway_tpu.reliability.deadline import Deadline
+    from llmapigateway_tpu.engine.engine import Delta, GenRequest
+
+    t = [1000.0]
+    clock = lambda: t[0]                       # noqa: E731
+    deadline = Deadline(0.5, clock=clock)
+    provider = LocalProvider.__new__(LocalProvider)   # no engine needed
+    provider.name = "tpu"
+    from llmapigateway_tpu.obs.metrics import get_metrics
+    provider._metrics = get_metrics()
+
+    req = GenRequest(prompt_ids=[1, 2, 3], max_tokens=10)
+    req.t_admitted = req.t_submit
+    req.t_first_token = req.t_submit
+
+    class _Detok:
+        def flush(self):
+            return ""
+    req.detok = _Detok()
+
+    async def deltas():
+        yield Delta(text="world")
+        t[0] += 1.0                            # budget gone mid-stream
+        yield Delta(text="never sent")
+        raise AssertionError("stream must stop at the deadline")
+
+    class _Obs:
+        ended = None
+
+        def on_content_delta(self, text):
+            pass
+
+        def on_usage(self, usage):
+            pass
+
+        def on_stream_end(self, error=None):
+            self.ended = error or "clean"
+
+    tracer = Tracer(clock=clock)
+    observer = _Obs()
+    first = Delta(text="hello")
+    stream_iter = deltas()
+    with tracer.trace("local-chaos-1"):
+        with obs_trace.span("provider.call", layer="provider") as call:
+            frames = [f async for f in provider._sse_frames(
+                req, stream_iter, first, "tiny-test", observer,
+                deadline=deadline, parent=call)]
+    await stream_iter.aclose()      # abandoned by the early deadline return
+    last = json.loads(frames[-1].decode().split("data: ", 1)[1])
+    assert last["error"]["code"] == 504
+    assert "deadline" in last["error"]["message"]
+    assert req.cancelled is True               # slot will be freed
+    assert observer.ended == "deadline expired mid-stream"
+    doc = tracer.get("local-chaos-1")
+    assert_all_closed(doc)
+    decode = [s for s in walk_spans(doc["spans"])
+              if s["name"] == "engine.decode"]
+    assert decode and decode[0]["attrs"]["error"].startswith("deadline")

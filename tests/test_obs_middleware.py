@@ -1,0 +1,201 @@
+"""Middleware observability satellites (ISSUE 4): client-supplied
+x-request-id honoring, greppable request-end lines (method/path + the
+prepared status of a stream that died mid-flight), the CORS Vary append
+path, payload redaction, and the http_* metrics the middleware records."""
+import logging
+
+from aiohttp import web
+from aiohttp.test_utils import TestClient, TestServer
+
+from llmapigateway_tpu.obs.metrics import GatewayMetrics
+from llmapigateway_tpu.obs.trace import Tracer
+from llmapigateway_tpu.server.middleware import (
+    _redacted_payload,
+    cors_middleware,
+    request_id_header_middleware,
+    request_logging_middleware,
+)
+
+
+async def make_client(app):
+    client = TestClient(TestServer(app))
+    await client.start_server()
+    return client
+
+
+def obs_app(handler_map, metrics=None, tracer=None):
+    app = web.Application(middlewares=[
+        request_id_header_middleware(),
+        request_logging_middleware(metrics=metrics, tracer=tracer),
+    ])
+    for path, handler in handler_map.items():
+        app.router.add_get(path, handler)
+    return app
+
+
+async def ok(request):
+    return web.json_response({"ok": True})
+
+
+# -- x-request-id honoring ----------------------------------------------------
+
+async def test_client_request_id_honored():
+    client = await make_client(obs_app({"/x": ok}))
+    try:
+        resp = await client.get("/x", headers={"x-request-id": "my-trace_01"})
+        assert resp.headers["x-request-id"] == "my-trace_01"
+    finally:
+        await client.close()
+
+
+async def test_invalid_request_id_falls_back_to_generated():
+    client = await make_client(obs_app({"/x": ok}))
+    try:
+        for bad in ("x" * 65, "has space", "semi;colon", "é-accent"):
+            resp = await client.get("/x", headers={"x-request-id": bad})
+            got = resp.headers["x-request-id"]
+            assert got != bad
+            assert len(got) == 16           # generated uuid4 prefix
+    finally:
+        await client.close()
+
+
+# -- request-end log line -----------------------------------------------------
+
+async def test_request_end_log_carries_method_and_path(caplog):
+    client = await make_client(obs_app({"/x": ok}))
+    try:
+        with caplog.at_level(logging.INFO, logger="gateway.request"):
+            await client.get("/x", headers={"x-request-id": "endline-1"})
+    finally:
+        await client.close()
+    ends = [r for r in caplog.records
+            if r.getMessage() == "request end"
+            and getattr(r, "request_id", "") == "endline-1"]
+    assert ends, "no request end line"
+    rec = ends[0]
+    # Greppable on its own: method/path/status/duration all present.
+    assert rec.method == "GET" and rec.path == "/x"
+    assert rec.status == 200 and rec.duration_ms >= 0
+
+
+async def test_stream_death_logs_prepared_status(caplog):
+    """A handler that commits a 200 stream then raises: the end line must
+    record the status that actually went on the wire (plus the death),
+    not a fictitious 500."""
+    async def dying_stream(request):
+        resp = web.StreamResponse(status=200)
+        request["prepared_status"] = 200
+        await resp.prepare(request)
+        await resp.write(b"data: hello\n\n")
+        raise RuntimeError("upstream died mid-stream")
+
+    client = await make_client(obs_app({"/stream": dying_stream}))
+    try:
+        with caplog.at_level(logging.INFO, logger="gateway.request"):
+            try:
+                resp = await client.get(
+                    "/stream", headers={"x-request-id": "dying-1"})
+                await resp.read()
+            except Exception:
+                pass
+    finally:
+        await client.close()
+    ends = [r for r in caplog.records
+            if r.getMessage() == "request end"
+            and getattr(r, "request_id", "") == "dying-1"]
+    assert ends
+    assert ends[0].status == 200            # what the wire saw
+    assert getattr(ends[0], "stream_error", False) is True
+
+
+# -- http metrics -------------------------------------------------------------
+
+async def test_http_metrics_recorded_per_route():
+    from tests.test_metrics import validate_prometheus_text
+    metrics = GatewayMetrics()
+    client = await make_client(obs_app({"/x": ok}, metrics=metrics))
+    try:
+        for _ in range(3):
+            await client.get("/x")
+        await client.get("/missing")
+    finally:
+        await client.close()
+    fams = validate_prometheus_text(metrics.render())
+    totals = {tuple(sorted(l.items())): v for _, l, v in
+              fams["gateway_http_requests_total"]["samples"]}
+    assert totals[(("method", "GET"), ("path", "/x"),
+                   ("status", "200"))] == 3
+    assert totals[(("method", "GET"), ("path", "unmatched"),
+                   ("status", "404"))] == 1
+    durations = fams["gateway_http_request_duration_seconds"]["samples"]
+    count = [v for n, l, v in durations
+             if n.endswith("_count") and l.get("path") == "/x"]
+    assert count == [3]
+    # In-flight returned to zero.
+    (sample,) = fams["gateway_http_requests_in_flight_total"]["samples"]
+    assert sample[2] == 0
+
+
+async def test_trace_root_records_status_and_closes():
+    tracer = Tracer()
+    client = await make_client(obs_app({"/x": ok}, tracer=tracer))
+    try:
+        await client.get("/x", headers={"x-request-id": "rooted-1"})
+    finally:
+        await client.close()
+    doc = tracer.get("rooted-1")
+    assert doc["complete"] is True
+    assert doc["spans"]["attrs"]["status"] == 200
+    assert doc["spans"]["attrs"]["path"] == "/x"
+
+
+# -- CORS Vary append path ----------------------------------------------------
+
+async def test_cors_appends_origin_to_handler_vary():
+    """A handler that already varies (Accept) must end up with BOTH: the
+    middleware appends, never clobbers (previously untested directly)."""
+    async def vary_handler(request):
+        return web.json_response({}, headers={"Vary": "Accept"})
+
+    app = web.Application(middlewares=[cors_middleware(["http://a.example"])])
+    app.router.add_get("/x", vary_handler)
+    client = await make_client(app)
+    try:
+        resp = await client.get("/x", headers={"Origin": "http://a.example"})
+        assert resp.headers["Vary"] == "Accept, Origin"
+        # Already-present Origin (any case) is not duplicated.
+        async def vary_origin(request):
+            return web.json_response({}, headers={"Vary": "origin"})
+        app2 = web.Application(
+            middlewares=[cors_middleware(["http://a.example"])])
+        app2.router.add_get("/x", vary_origin)
+        client2 = await make_client(app2)
+        try:
+            resp = await client2.get("/x")
+            assert resp.headers["Vary"] == "origin"
+        finally:
+            await client2.close()
+    finally:
+        await client.close()
+
+
+# -- payload redaction (direct) ----------------------------------------------
+
+def test_redacted_payload_masks_contents_keeps_params():
+    raw = (b'{"model": "m", "temperature": 0.2,'
+           b' "messages": [{"role": "user", "content": "secret"}],'
+           b' "tools": [{"type": "function"}]}')
+    p = _redacted_payload(raw)
+    assert p["model"] == "m" and p["temperature"] == 0.2
+    assert p["messages"] == "<redacted: 1 messages>"
+    assert p["tools"] == "<redacted: 1 tools>"
+    assert "secret" not in str(p)
+
+
+def test_redacted_payload_handles_junk():
+    assert _redacted_payload(b"not json") is None
+    assert _redacted_payload(b'["a", "list"]') is None
+    # Non-list message field still masked.
+    p = _redacted_payload(b'{"messages": "raw string"}')
+    assert p["messages"] == "<redacted>"

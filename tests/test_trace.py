@@ -1,0 +1,155 @@
+"""obs/trace.py: span nesting via contextvars, the ring buffer, post-hoc
+engine-phase recording, no-op behavior without an active trace, and the
+Server-Timing summary — all under a fake clock."""
+import asyncio
+
+from llmapigateway_tpu.obs import trace as obs_trace
+from llmapigateway_tpu.obs.trace import Tracer
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_span_tree_nesting_and_offsets():
+    clock = FakeClock()
+    tracer = Tracer(clock=clock)
+    with tracer.trace("r1"):
+        clock.advance(0.010)
+        with obs_trace.span("router.attempt", layer="router", provider="p"):
+            clock.advance(0.005)
+            with obs_trace.span("provider.call", layer="provider"):
+                clock.advance(0.100)
+        clock.advance(0.001)
+    doc = tracer.get("r1")
+    assert doc["complete"] is True
+    root = doc["spans"]
+    assert root["name"] == "gateway" and root["duration_ms"] == 116.0
+    (attempt,) = root["children"]
+    assert attempt["start_ms"] == 10.0 and attempt["duration_ms"] == 105.0
+    assert attempt["attrs"]["provider"] == "p"
+    (call,) = attempt["children"]
+    assert call["layer"] == "provider"
+    assert call["start_ms"] == 15.0 and call["duration_ms"] == 100.0
+
+
+def test_span_closes_on_exception():
+    clock = FakeClock()
+    tracer = Tracer(clock=clock)
+    try:
+        with tracer.trace("r1"):
+            with obs_trace.span("router.attempt", layer="router"):
+                clock.advance(0.050)
+                raise RuntimeError("mid-span failure")
+    except RuntimeError:
+        pass
+    doc = tracer.get("r1")
+    assert doc["complete"] is True
+    (attempt,) = doc["spans"]["children"]
+    assert attempt["duration_ms"] == 50.0       # closed, not leaked
+
+
+def test_noop_without_active_trace():
+    # No trace → span() yields None and record_span returns None; neither
+    # throws (unit tests and the bench never pay for tracing).
+    with obs_trace.span("router.attempt", layer="router") as sp:
+        assert sp is None
+    assert obs_trace.record_span("engine.decode", layer="engine") is None
+    assert obs_trace.current_request_id() is None
+    assert obs_trace.server_timing_header() == ""
+
+
+def test_record_span_post_hoc_with_explicit_times_and_parent():
+    clock = FakeClock()
+    tracer = Tracer(clock=clock)
+    with tracer.trace("r1"):
+        with obs_trace.span("provider.call", layer="provider") as call:
+            clock.advance(0.2)
+        # Engine phases land under the captured parent even after it
+        # closed (the local provider records them at stream end).
+        obs_trace.record_span("engine.decode", layer="engine",
+                              start=1000.05, end=1000.15, parent=call,
+                              tokens=12)
+    doc = tracer.get("r1")
+    (call_d,) = doc["spans"]["children"]
+    (decode,) = call_d["children"]
+    assert decode["start_ms"] == 50.0 and decode["duration_ms"] == 100.0
+    assert decode["attrs"]["tokens"] == 12
+
+
+def test_record_span_defaults_are_zero_length_markers():
+    clock = FakeClock()
+    tracer = Tracer(clock=clock)
+    with tracer.trace("r1"):
+        clock.advance(0.025)
+        obs_trace.record_span("router.breaker_skip", layer="router",
+                              provider="dead")
+    (skip,) = tracer.get("r1")["spans"]["children"]
+    assert skip["start_ms"] == 25.0 and skip["duration_ms"] == 0.0
+
+
+def test_ring_buffer_evicts_oldest():
+    tracer = Tracer(capacity=3, clock=FakeClock())
+    for i in range(5):
+        with tracer.trace(f"r{i}"):
+            pass
+    assert tracer.get("r0") is None and tracer.get("r1") is None
+    assert tracer.get("r2") is not None and tracer.get("r4") is not None
+    assert len(tracer) == 3
+
+
+def test_inflight_trace_is_queryable_incomplete():
+    clock = FakeClock()
+    tracer = Tracer(clock=clock)
+    with tracer.trace("live"):
+        doc = tracer.get("live")
+        assert doc["complete"] is False
+        assert doc["spans"]["duration_ms"] is None
+    assert tracer.get("live")["complete"] is True
+
+
+def test_server_timing_header_lists_closed_spans():
+    clock = FakeClock()
+    tracer = Tracer(clock=clock)
+    with tracer.trace("r1"):
+        with obs_trace.span("router.attempt", layer="router"):
+            clock.advance(0.0421)
+        header = obs_trace.server_timing_header()
+    assert header.startswith("total;dur=42.1")
+    assert "router_attempt;dur=42.1" in header
+
+
+async def test_concurrent_tasks_do_not_cross_contaminate():
+    """Two requests traced concurrently: each task's spans land in its own
+    tree (the contextvars isolation the whole design rests on)."""
+    tracer = Tracer()
+    started = asyncio.Event()
+    release = asyncio.Event()
+
+    async def request_a():
+        with tracer.trace("a"):
+            with obs_trace.span("router.attempt", layer="router",
+                                who="a"):
+                started.set()
+                await release.wait()
+
+    async def request_b():
+        await started.wait()
+        with tracer.trace("b"):
+            with obs_trace.span("router.attempt", layer="router",
+                                who="b"):
+                pass
+        release.set()
+
+    await asyncio.gather(request_a(), request_b())
+    (a_span,) = tracer.get("a")["spans"]["children"]
+    (b_span,) = tracer.get("b")["spans"]["children"]
+    assert a_span["attrs"]["who"] == "a"
+    assert b_span["attrs"]["who"] == "b"
