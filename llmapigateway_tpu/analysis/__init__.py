@@ -13,12 +13,14 @@ and the rule catalog are documented in tools/README.md.
 """
 from __future__ import annotations
 
-from .core import (Finding, Rule, analyze_file, analyze_paths,
+from .core import (ChainHop, Finding, Rule, analyze_file, analyze_paths,
                    analyze_source, iter_python_files, package_relpath)
+from .program import Program, analyze_program, summarize_module, summarize_source
 from .rules import ALL_RULES, RULES_BY_NAME
 
 __all__ = [
-    "ALL_RULES", "RULES_BY_NAME", "Finding", "Rule", "analyze_file",
-    "analyze_paths", "analyze_source", "iter_python_files",
-    "package_relpath",
+    "ALL_RULES", "RULES_BY_NAME", "ChainHop", "Finding", "Program", "Rule",
+    "analyze_file", "analyze_paths", "analyze_program", "analyze_source",
+    "iter_python_files", "package_relpath", "summarize_module",
+    "summarize_source",
 ]

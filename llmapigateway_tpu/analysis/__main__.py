@@ -1,27 +1,88 @@
-"""CLI entry point: ``python -m llmapigateway_tpu.analysis [paths...]``."""
+"""CLI entry point: ``python -m llmapigateway_tpu.analysis [paths...]``.
+
+v2 drives both layers: the per-file lexical rules AND the whole-program
+pass (symbol table + call graph + dataflow, analysis/program.py), with an
+mtime/content-hash incremental cache (analysis/cache.py) so warm runs and
+the tier-1 gate stay fast.
+
+Modes::
+
+    python -m llmapigateway_tpu.analysis llmapigateway_tpu/
+    python -m llmapigateway_tpu.analysis --format sarif > graftlint.sarif
+    python -m llmapigateway_tpu.analysis --changed origin/main   # pre-commit
+    python -m llmapigateway_tpu.analysis --cache /tmp/gl.json pkg/
+
+Exit code 0 = clean; 1 = findings; 2 = usage error.
+"""
 from __future__ import annotations
 
 import argparse
+import subprocess
 import sys
 from pathlib import Path
 
-from .core import analyze_file, iter_python_files
-from .reporter import render_json, render_rules, render_text
+from .cache import LintCache
+from .core import analyze_source, iter_python_files, package_relpath
+from .program import analyze_program, summarize_source
+from .reporter import render_json, render_rules, render_sarif, render_text
 from .rules import ALL_RULES, RULES_BY_NAME
+
+DEFAULT_CACHE = ".graftlint_cache.json"
+
+
+def _repo_root(start: Path) -> Path | None:
+    for p in (start, *start.parents):
+        if (p / ".git").exists():
+            return p
+    return None
+
+
+def _changed_files(ref: str, repo: Path) -> list[Path] | None:
+    """Tracked files differing from ``ref`` plus untracked files; None on
+    git failure (caller reports the usage error)."""
+    files: set[str] = set()
+    for args in (["diff", "--name-only", ref, "--"],
+                 ["ls-files", "--others", "--exclude-standard"]):
+        proc = subprocess.run(["git", "-C", str(repo), *args],
+                              capture_output=True, text=True)
+        if proc.returncode != 0:
+            sys.stderr.write(proc.stderr)
+            return None
+        files.update(l.strip() for l in proc.stdout.splitlines() if l.strip())
+    out = []
+    for rel in sorted(files):
+        p = repo / rel
+        if p.suffix == ".py" and p.exists():
+            out.append(p)
+    return out
 
 
 def main(argv: list[str] | None = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m llmapigateway_tpu.analysis",
-        description="graftlint: AST-based invariant checker for the gateway")
+        description="graftlint v2: per-file invariants + whole-program "
+                    "dataflow analysis for the gateway")
     parser.add_argument("paths", nargs="*",
                         help="files/directories to check (default: the "
                              "installed llmapigateway_tpu package)")
-    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text")
     parser.add_argument("--rules", default="",
                         help="comma-separated subset of rules to run")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
+    parser.add_argument("--changed", metavar="GIT_REF", default="",
+                        help="lint only files differing from GIT_REF "
+                             "(plus untracked); the whole-program pass "
+                             "still sees the full tree, reported findings "
+                             "are filtered to the changed set")
+    parser.add_argument("--cache", metavar="PATH", default="",
+                        help=f"incremental cache file (mtime+sha256 keyed); "
+                             f"--changed defaults it to ./{DEFAULT_CACHE}")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="disable the incremental cache")
+    parser.add_argument("--no-program", action="store_true",
+                        help="skip the whole-program (interprocedural) pass")
     args = parser.parse_args(argv)
 
     if args.list_rules:
@@ -38,22 +99,100 @@ def main(argv: list[str] | None = None) -> int:
                   f"{', '.join(sorted(RULES_BY_NAME))}", file=sys.stderr)
             return 2
 
-    paths = args.paths or [str(Path(__file__).resolve().parents[1])]
-    findings = []
-    n_files = 0
-    for p in paths:
-        root = Path(p)
-        if not root.exists():
-            print(f"no such path: {p}", file=sys.stderr)
+    package_dir = Path(__file__).resolve().parents[1]
+
+    cache = None
+    cache_path = args.cache
+    if args.changed and not cache_path and not args.no_cache:
+        cache_path = DEFAULT_CACHE
+    if cache_path and not args.no_cache:
+        cache = LintCache(cache_path,
+                          rule_names=tuple(r.name for r in rules))
+
+    # -- the file set --------------------------------------------------------
+    report_only: set[str] | None = None
+    program_roots: list[Path]
+    if args.changed:
+        repo = _repo_root(package_dir)
+        if repo is None:
+            print("--changed needs a git repository above the package",
+                  file=sys.stderr)
             return 2
-        base = root if root.is_dir() else root.parent
-        for f in iter_python_files(root):
-            n_files += 1
-            findings.extend(analyze_file(f, rules, base))
+        changed = _changed_files(args.changed, repo)
+        if changed is None:
+            print(f"git diff against {args.changed!r} failed", file=sys.stderr)
+            return 2
+        file_sets = [(p, p.parent) for p in changed]
+        report_only = {package_relpath(p, base) for p, base in file_sets}
+        program_roots = [package_dir]
+    else:
+        roots = [Path(p) for p in (args.paths or [str(package_dir)])]
+        for root in roots:
+            if not root.exists():
+                print(f"no such path: {root}", file=sys.stderr)
+                return 2
+        file_sets = []
+        for root in roots:
+            base = root if root.is_dir() else root.parent
+            file_sets.extend((f, base) for f in iter_python_files(root))
+        program_roots = roots
+
+    # -- per-file lexical pass (cache-aware) ---------------------------------
+    findings = []
+    summaries: dict[str, dict] = {}
+    n_files = 0
+    for f, base in file_sets:
+        n_files += 1
+        rel = package_relpath(f, base)
+        if cache is not None:
+            hit = cache.lookup(f, rel)
+            if hit is not None:
+                file_findings, summary, _ = hit
+                findings.extend(file_findings)
+                if summary is not None:
+                    summaries[rel] = summary
+                continue
+        try:
+            src = f.read_text()
+        except OSError as e:
+            print(f"cannot read {f}: {e}", file=sys.stderr)
+            return 2
+        file_findings = analyze_source(src, f, rules, base)
+        summary = summarize_source(src, f, base)
+        findings.extend(file_findings)
+        if summary is not None:
+            summaries[rel] = summary
+        if cache is not None:
+            cache.store(f, rel, src, file_findings, summary)
+
+    # -- whole-program pass --------------------------------------------------
+    if not args.no_program:
+        # With --changed, unchanged files' summaries come from the cache
+        # (analyze_program parses whatever is still missing).
+        if cache is not None and args.changed:
+            for root in program_roots:
+                base = root if root.is_dir() else root.parent
+                for f in iter_python_files(root):
+                    rel = package_relpath(f, base)
+                    if rel in summaries:
+                        continue
+                    hit = cache.lookup(f, rel)
+                    if hit is not None and hit[1] is not None:
+                        summaries[rel] = hit[1]
+        findings.extend(analyze_program(program_roots, summaries=summaries,
+                                        report_only=report_only))
+
+    if cache is not None:
+        cache.save()
+
     findings.sort(key=lambda x: (x.path, x.line, x.col, x.rule))
 
-    render = render_json if args.format == "json" else render_text
-    print(render(findings, checked_files=n_files))
+    if args.format == "sarif":
+        print(render_sarif(findings, checked_files=n_files, rules=rules))
+    elif args.format == "json":
+        print(render_json(findings, checked_files=n_files))
+    else:
+        print(render_text(findings, checked_files=n_files))
     return 1 if findings else 0
 
 

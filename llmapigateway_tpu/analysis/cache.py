@@ -1,0 +1,116 @@
+"""Incremental analysis cache: mtime + content-hash keyed per file.
+
+The tier-1 gate runs graftlint over the whole package on every test
+session, and the ``--changed`` pre-commit mode re-lints on every commit;
+both would otherwise re-parse ~120 files to re-derive results that almost
+never change. The cache stores, per file, the two things that are
+expensive to recompute: the *lexical findings* (per-file rules) and the
+*module summary* (the whole-program pass's input, analysis/program.py) —
+so a warm run parses only files whose content actually changed and the
+interprocedural pass runs over cached summaries.
+
+Validation is two-tier: a matching ``mtime_ns`` is a hit without even
+reading the file; a changed mtime falls back to the sha256 of the content
+(rebuilds, ``git checkout`` round-trips, and touch(1) don't invalidate).
+The cache key folds in the rule names and a schema version, so adding a
+rule or changing the summary format invalidates everything at once
+instead of serving stale results.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+from typing import Any
+
+from .core import ChainHop, Finding
+from .program import SUMMARY_VERSION
+
+CACHE_SCHEMA = 1
+
+
+def _finding_from_dict(d: dict[str, Any]) -> Finding:
+    return Finding(rule=d["rule"], path=d["path"], line=d["line"],
+                   col=d["col"], message=d["message"],
+                   chain=tuple(ChainHop(h["path"], h["line"], h["note"])
+                               for h in d.get("chain", ())))
+
+
+class LintCache:
+    """One JSON file mapping relpath → {mtime_ns, sha256, findings,
+    summary}. Load once, :meth:`save` once at the end of a run."""
+
+    def __init__(self, path: str | Path, rule_names: tuple[str, ...] = ()):
+        self.path = Path(path)
+        self.key = f"{CACHE_SCHEMA}/{SUMMARY_VERSION}/" + ",".join(sorted(rule_names))
+        self._files: dict[str, dict[str, Any]] = {}
+        self.hits = 0
+        self.misses = 0
+        self._dirty = False
+        try:
+            doc = json.loads(self.path.read_text())
+            if doc.get("key") == self.key:
+                self._files = doc.get("files", {})
+        except (OSError, ValueError):
+            pass
+
+    # -- lookup -------------------------------------------------------------
+    def lookup(self, file_path: Path, relpath: str
+               ) -> tuple[list[Finding], dict[str, Any] | None, str | None] | None:
+        """(findings, summary, source_or_None) on a hit, else None. Source
+        is returned only when the hash fallback had to read the file — the
+        caller reuses it instead of reading twice."""
+        entry = self._files.get(relpath)
+        if entry is None:
+            self.misses += 1
+            return None
+        try:
+            mtime_ns = file_path.stat().st_mtime_ns
+        except OSError:
+            self.misses += 1
+            return None
+        source: str | None = None
+        if entry["mtime_ns"] != mtime_ns:
+            try:
+                source = file_path.read_text()
+            except OSError:
+                self.misses += 1
+                return None
+            if hashlib.sha256(source.encode()).hexdigest() != entry["sha256"]:
+                self.misses += 1
+                return None
+            entry["mtime_ns"] = mtime_ns      # content same: refresh mtime
+            self._dirty = True
+        self.hits += 1
+        findings = [_finding_from_dict(d) for d in entry["findings"]]
+        return findings, entry.get("summary"), source
+
+    # -- store --------------------------------------------------------------
+    def store(self, file_path: Path, relpath: str, source: str,
+              findings: list[Finding], summary: dict[str, Any] | None) -> None:
+        try:
+            mtime_ns = file_path.stat().st_mtime_ns
+        except OSError:
+            return
+        self._files[relpath] = {
+            "mtime_ns": mtime_ns,
+            "sha256": hashlib.sha256(source.encode()).hexdigest(),
+            "findings": [f.to_dict() for f in findings],
+            "summary": summary,
+        }
+        self._dirty = True
+
+    def save(self) -> None:
+        if not self._dirty:
+            return
+        doc = {"key": self.key, "files": self._files}
+        try:
+            self.path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = self.path.with_suffix(self.path.suffix + ".tmp")
+            tmp.write_text(json.dumps(doc))
+            tmp.replace(self.path)
+        except OSError:
+            pass                    # cache is an optimization only
+
+    def __len__(self) -> int:
+        return len(self._files)

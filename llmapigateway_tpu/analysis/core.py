@@ -37,20 +37,44 @@ _SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=([\w\-,\s]+)")
 
 
 @dataclass(frozen=True)
+class ChainHop:
+    """One hop of an interprocedural finding's call chain: where the call
+    (or dispatch, or blocking primitive) sits and what it does."""
+    path: str
+    line: int
+    note: str
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "note": self.note}
+
+
+@dataclass(frozen=True)
 class Finding:
-    """One rule violation at a source location."""
+    """One rule violation at a source location. Whole-program findings
+    (analysis/program.py) carry the full call chain — every file:line hop
+    from the flagged site to the primitive that makes it a violation — in
+    ``chain``; per-file lexical findings leave it empty."""
     rule: str
     path: str
     line: int
     col: int
     message: str
+    chain: tuple[ChainHop, ...] = ()
 
     def to_dict(self) -> dict:
-        return {"rule": self.rule, "path": self.path, "line": self.line,
-                "col": self.col, "message": self.message}
+        d = {"rule": self.rule, "path": self.path, "line": self.line,
+             "col": self.col, "message": self.message}
+        if self.chain:
+            d["chain"] = [h.to_dict() for h in self.chain]
+        return d
 
     def render(self) -> str:
-        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+        head = f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+        if not self.chain:
+            return head
+        hops = "\n".join(f"    {i + 1}. {h.path}:{h.line}: {h.note}"
+                         for i, h in enumerate(self.chain))
+        return head + "\n" + hops
 
 
 class Rule:

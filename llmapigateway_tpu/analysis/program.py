@@ -1,0 +1,966 @@
+"""graftlint v2 whole-program core: symbol table, call graph, dataflow.
+
+The per-file rules (rules/) see one module at a time, so one transitive
+call through a sync helper in another module defeats every one of them: an
+``async def`` handler that calls ``ConfigLoader.read_raw`` blocks the
+event loop on ``Path.read_text`` two files away, a free function that
+mutates a ``# guarded-by:``-annotated attribute it received as a parameter
+escapes the lock check, and an httpx client handed to a helper loses its
+``timeout=`` discipline at the project boundary. This module closes that
+gap with a project-wide pass:
+
+* **Symbol table + call graph.** Every module is summarized once
+  (:func:`summarize_module`) into a JSON-serializable record of its
+  functions (incl. nested defs and methods), the calls each makes, direct
+  blocking primitives, guarded-attribute accesses, thread-dispatch sites,
+  and httpx usage. Summaries are what the incremental cache
+  (analysis/cache.py) stores — an unchanged file is never re-parsed.
+  :class:`Program` links summaries into a cross-module call graph: bare
+  names resolve through lexical scope then imports (relative and
+  absolute), ``self.X`` through the enclosing class, ``Cls.method``
+  through imported classes, and otherwise-unresolvable method calls
+  devirtualize by *project-unique method name* (a method name defined by
+  exactly one class in the tree, excluding ubiquitous container/stdlib
+  names) — the cheap trick that makes ``gw.loader.read_raw(...)`` resolve
+  without a type system.
+
+* **async-blocking, transitive.** From every ``async def`` in the serving
+  layers (server/, routing/, providers/), a BFS over *call* edges (a
+  function passed by reference to ``asyncio.to_thread`` /
+  ``run_in_executor`` / ``Thread(target=...)`` creates no edge — that is
+  the sanctioned offload) finds the shortest chain to a function that
+  performs a blocking primitive. The finding carries every file:line hop.
+  Depth-0 (the primitive lexically inside the entry) is the per-file
+  rule's business and is not re-reported.
+
+* **lock-discipline, inferred.** ``# guarded-by:`` annotations are
+  collected across the whole tree into a class→attr→guard index. Two
+  whole-program checks: (1) *external access* — code outside the owning
+  class that reads or mutates a guarded attribute through a parameter
+  annotated with the class must hold the declared lock; (2) *thread
+  reachability* — any access to a ``guarded-by: loop`` attribute in a
+  function reachable (through the whole-program call graph) from a
+  thread-dispatch site is flagged with the dispatch chain.
+
+* **timeout-discipline, dataflow.** httpx clients (``httpx.AsyncClient``
+  constructions and ``*client*``-named handles) passed as arguments from
+  providers/ are tracked through function parameters to a fixpoint; an
+  HTTP-method call on a tainted parameter without ``timeout=`` is flagged
+  wherever it lives, chain attached.
+
+Findings reuse the per-file rule names (``async-blocking``,
+``lock-discipline``, ``timeout-discipline``) so one suppression syntax
+covers both layers; ``# graftlint: disable=`` comments in the flagged file
+apply exactly as they do for lexical findings.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from collections import deque
+from pathlib import Path
+from typing import Any, Iterable
+
+from .core import ChainHop, Finding, Suppressions, iter_python_files, package_relpath
+from .rules._util import dotted_name
+from .rules.async_blocking import classify_blocking_call
+from .rules.lock_discipline import _GUARDED_RE, _MUTATORS
+
+SUMMARY_VERSION = 3
+
+# Entry scope for the transitive async-blocking pass (matches the lexical
+# rule's dirs) and for the timeout dataflow seed.
+SERVING_DIRS = ("server", "routing", "providers")
+PROVIDER_DIRS = ("providers",)
+
+# Method names never devirtualized by uniqueness: they collide with
+# builtin container/stdlib methods, so an attribute call with this name is
+# far more likely a dict/list/Path/logger/re/np operation than the one
+# project method that happens to share it.
+_DEVIRT_DENY = frozenset({
+    "get", "put", "pop", "close", "open", "read", "write", "send", "recv",
+    "update", "items", "keys", "values", "append", "extend", "insert",
+    "remove", "clear", "copy", "sort", "reverse", "index", "count",
+    "encode", "decode", "join", "split", "strip", "format", "add",
+    "discard", "setdefault", "popitem", "run", "start", "stop", "wait",
+    "set", "release", "acquire", "cancel", "done", "result", "exception",
+    "flush", "seek", "tell", "readline", "readlines", "writelines",
+    "submit", "apply", "mkdir", "exists", "unlink", "glob", "resolve",
+    "info", "debug", "warning", "error", "critical", "log", "observe",
+    "inc", "dec", "labels", "feed", "match", "search", "sub", "findall",
+    "group", "loads", "dumps", "load", "dump", "sleep", "connect",
+    "execute", "commit", "rollback", "fetchone", "fetchall", "item",
+    "tolist", "astype", "reshape", "mean", "sum", "any", "all", "min",
+    "max", "next", "name", "total", "render", "check", "empty", "qsize",
+})
+
+_HTTP_METHODS = frozenset({"get", "post", "put", "patch", "delete",
+                           "request", "stream", "build_request"})
+_THREAD_DISPATCH = frozenset({"to_thread", "run_in_executor"})
+
+_LOCK_CTORS = frozenset({
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "threading.Semaphore", "asyncio.Lock", "asyncio.Condition",
+    "asyncio.Semaphore"})
+
+PACKAGE_NAME = "llmapigateway_tpu"
+
+
+def _module_name(relpath: str) -> str:
+    """Dotted module name for a package-relative path: ``server/app.py`` →
+    ``server.app``; ``__init__.py`` files name their package."""
+    parts = relpath[:-3].split("/") if relpath.endswith(".py") else relpath.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _is_client_expr(node: ast.AST) -> bool:
+    """True for expressions that are httpx clients by project convention:
+    a ``httpx.AsyncClient(...)``/``httpx.Client(...)`` construction or a
+    name/attribute whose terminal name contains ``client``."""
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        return name in ("httpx.AsyncClient", "httpx.Client")
+    name = dotted_name(node)
+    if name is None:
+        return False
+    return "client" in name.split(".")[-1].lower()
+
+
+class _FnCollector(ast.NodeVisitor):
+    """Summarizes one function body (NOT descending into nested defs —
+    each nested def is its own function record)."""
+
+    def __init__(self, summ: "_FnSummary", class_name: str | None,
+                 param_types: dict[str, str], lines: list[str]):
+        self.s = summ
+        self.class_name = class_name
+        self.param_types = dict(param_types)    # name -> annotated class
+        self.lines = lines
+        self.lock_stack: list[list[str]] = [[]]
+        self._local_ctor: dict[str, str] = {}   # local -> ClassName(...)
+
+    # -- nested defs are separate records -------------------------------
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        pass
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        pass
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        pass
+
+    # -- with blocks track held locks ------------------------------------
+    def _with_locks(self, node: ast.With | ast.AsyncWith) -> list[str]:
+        held = []
+        for item in node.items:
+            name = dotted_name(item.context_expr)
+            if name:
+                held.append(name)       # "self._lock", "loader._lock", "_lock"
+        return held
+
+    def visit_With(self, node: ast.With) -> None:
+        self.lock_stack.append(self.lock_stack[-1] + self._with_locks(node))
+        self.generic_visit(node)
+        self.lock_stack.pop()
+
+    visit_AsyncWith = visit_With
+
+    # -- receivers --------------------------------------------------------
+    def _recv_class(self, node: ast.AST) -> tuple[str, str] | None:
+        """(receiver_name, class) when the expression is a name/``self``
+        with an inferable project class."""
+        if isinstance(node, ast.Name):
+            cls = self.param_types.get(node.id) or self._local_ctor.get(node.id)
+            if cls:
+                return node.id, cls
+        return None
+
+    def _record_access(self, attr_node: ast.Attribute, mutate: bool) -> None:
+        if isinstance(attr_node.value, ast.Name) and attr_node.value.id == "self":
+            if self.class_name:
+                self.s.accesses.append({
+                    "recv": "self", "cls": self.class_name,
+                    "attr": attr_node.attr, "line": attr_node.lineno,
+                    "mut": mutate, "locks": list(self.lock_stack[-1])})
+            return
+        rc = self._recv_class(attr_node.value)
+        if rc is not None:
+            self.s.accesses.append({
+                "recv": rc[0], "cls": rc[1], "attr": attr_node.attr,
+                "line": attr_node.lineno, "mut": mutate,
+                "locks": list(self.lock_stack[-1])})
+
+    # -- statements --------------------------------------------------------
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # Local constructed from a known class: x = ClassName(...)
+        if (isinstance(node.value, ast.Call)
+                and isinstance(node.value.func, ast.Name)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            self._local_ctor[node.targets[0].id] = node.value.func.id
+        for t in node.targets:
+            self._mark_target(t)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        self._mark_target(node.target)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._mark_target(node.target)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for t in node.targets:
+            self._mark_target(t)
+        self.generic_visit(node)
+
+    def _mark_target(self, target: ast.AST) -> None:
+        if isinstance(target, ast.Subscript):
+            target = target.value
+        if isinstance(target, ast.Attribute):
+            self._record_access(target, mutate=True)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        # Reads (mutation sites were recorded at their statement; a second
+        # read record for the same node is harmless — checks dedupe).
+        if isinstance(node.ctx, ast.Load):
+            self._record_access(node, mutate=False)
+        self.generic_visit(node)
+
+    # -- calls -------------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        msg = classify_blocking_call(node)
+        if msg is not None:
+            self.s.blocking.append([node.lineno, msg])
+
+        name = dotted_name(node.func)
+        if name is not None:
+            self._record_call(node, name)
+            self._record_dispatch(node, name)
+        elif isinstance(node.func, ast.Attribute):
+            # Dynamic root (call result, subscript): record the terminal
+            # method name so unique-name devirtualization still applies.
+            self._record_call(node, "?." + node.func.attr)
+
+        # Mutator method call on a receiver attribute: self._table.update()
+        if isinstance(node.func, ast.Attribute) and node.func.attr in _MUTATORS:
+            recv = node.func.value
+            if isinstance(recv, ast.Subscript):
+                recv = recv.value
+            if isinstance(recv, ast.Attribute):
+                self._record_access(recv, mutate=True)
+
+        # httpx discipline: HTTP-method call on a bare name without timeout=.
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HTTP_METHODS
+                and isinstance(node.func.value, ast.Name)
+                and not any(kw.arg == "timeout" for kw in node.keywords)):
+            self.s.httpx_bare.append([node.func.value.id, node.func.attr,
+                                      node.lineno])
+        self.generic_visit(node)
+
+    def _record_call(self, node: ast.Call, name: str) -> None:
+        client_args: list[Any] = []
+        param_args: dict[str, str] = {}
+        for i, arg in enumerate(node.args):
+            if _is_client_expr(arg):
+                client_args.append(i)
+            if isinstance(arg, ast.Name) and arg.id in self.s.params:
+                param_args[str(i)] = arg.id
+        for kw in node.keywords:
+            if kw.arg is None:
+                continue
+            if _is_client_expr(kw.value):
+                client_args.append(kw.arg)
+            if isinstance(kw.value, ast.Name) and kw.value.id in self.s.params:
+                param_args[kw.arg] = kw.value.id
+        rec: dict[str, Any] = {"name": name, "line": node.lineno}
+        if client_args:
+            rec["client_args"] = client_args
+        if param_args:
+            rec["param_args"] = param_args
+        self.s.calls.append(rec)
+
+    def _record_dispatch(self, node: ast.Call, name: str) -> None:
+        """Functions handed BY REFERENCE to a worker thread."""
+        tail = name.split(".")[-1]
+        ref: ast.AST | None = None
+        if tail in _THREAD_DISPATCH and node.args:
+            # to_thread(fn, ...) / run_in_executor(None, fn, ...)
+            ref = node.args[1] if tail == "run_in_executor" and len(node.args) > 1 \
+                else node.args[0]
+        elif tail == "Thread":
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    ref = kw.value
+        if ref is None:
+            return
+        ref_name = dotted_name(ref)
+        if ref_name:
+            self.s.thread_refs.append([ref_name, node.lineno])
+
+
+class _FnSummary:
+    """Mutable builder for one function's summary dict."""
+
+    def __init__(self, qlocal: str, node: ast.AST, class_name: str | None):
+        self.qlocal = qlocal
+        self.line = node.lineno
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+        self.class_name = class_name
+        args = node.args
+        self.params = [a.arg for a in
+                       args.posonlyargs + args.args + args.kwonlyargs]
+        self.calls: list[dict[str, Any]] = []
+        self.blocking: list[list[Any]] = []
+        self.accesses: list[dict[str, Any]] = []
+        self.httpx_bare: list[list[Any]] = []
+        self.thread_refs: list[list[Any]] = []
+
+    def to_dict(self) -> dict[str, Any]:
+        return {"line": self.line, "is_async": self.is_async,
+                "class": self.class_name, "params": self.params,
+                "calls": self.calls, "blocking": self.blocking,
+                "accesses": self.accesses, "httpx_bare": self.httpx_bare,
+                "thread_refs": self.thread_refs}
+
+
+def _annotation_class(ann: ast.AST | None) -> str | None:
+    """Terminal class name of a simple annotation (``ConfigLoader``,
+    ``loader.ConfigLoader``, ``"InferenceEngine"`` string forms)."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        return ann.value.split(".")[-1].strip() or None
+    name = dotted_name(ann)
+    if name:
+        return name.split(".")[-1]
+    return None
+
+
+def summarize_module(tree: ast.Module, source: str, relpath: str) -> dict[str, Any]:
+    """One module's whole-program summary (JSON-serializable; cacheable)."""
+    lines = source.splitlines()
+    functions: dict[str, dict[str, Any]] = {}
+    classes: dict[str, dict[str, Any]] = {}
+
+    def guard_comment(node: ast.AST) -> str | None:
+        for ln in range(node.lineno, getattr(node, "end_lineno", node.lineno) + 1):
+            if ln <= len(lines):
+                m = _GUARDED_RE.search(lines[ln - 1])
+                if m:
+                    return m.group(1)
+        return None
+
+    def direct_nested_defs(node) -> list:
+        """Defs whose nearest enclosing def is ``node`` (no deeper)."""
+        found = []
+        stack = list(node.body)
+        while stack:
+            child = stack.pop()
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                found.append(child)
+                continue                # its own nested defs belong to it
+            stack.extend(ast.iter_child_nodes(child))
+        return found
+
+    def collect_fn(node, qlocal: str, class_name: str | None,
+                   param_types: dict[str, str]) -> None:
+        summ = _FnSummary(qlocal, node, class_name)
+        # Parameter annotations naming project classes.
+        args = node.args
+        ptypes = dict(param_types)
+        for a in args.posonlyargs + args.args + args.kwonlyargs:
+            cls = _annotation_class(a.annotation)
+            if cls:
+                ptypes[a.arg] = cls
+        col = _FnCollector(summ, class_name, ptypes, lines)
+        for child in node.body:
+            col.visit(child)
+        functions[qlocal] = summ.to_dict()
+        # Nested defs: separate records, scoped names.
+        for child in direct_nested_defs(node):
+            collect_fn(child, f"{qlocal}.{child.name}", class_name, ptypes)
+
+    # -- classes + their guards -------------------------------------------
+    for node in tree.body:
+        if isinstance(node, ast.ClassDef):
+            guards: dict[str, str] = {}
+            lock_kinds: dict[str, str] = {}
+            methods: list[str] = []
+            for sub in ast.walk(node):
+                if isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    targets = (sub.targets if isinstance(sub, ast.Assign)
+                               else [sub.target])
+                    for t in targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            g = guard_comment(sub)
+                            if g:
+                                guards[t.attr] = g
+                            if isinstance(sub.value, ast.Call):
+                                ctor = dotted_name(sub.value.func)
+                                if ctor in _LOCK_CTORS:
+                                    lock_kinds[t.attr] = (
+                                        "asyncio" if ctor.startswith("asyncio")
+                                        else "threading")
+            for stmt in node.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    methods.append(stmt.name)
+                    collect_fn(stmt, f"{node.name}.{stmt.name}", node.name, {})
+            classes[node.name] = {"line": node.lineno, "guards": guards,
+                                  "locks": lock_kinds, "methods": methods,
+                                  "bases": [b for b in
+                                            (dotted_name(x) for x in node.bases)
+                                            if b]}
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            collect_fn(node, node.name, None, {})
+
+    # -- imports -----------------------------------------------------------
+    module = _module_name(relpath)
+    pkg_parts = module.split(".")[:-1] if module else []
+    imports: dict[str, list[str | None]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                target = alias.name
+                if target.startswith(PACKAGE_NAME + "."):
+                    target = target[len(PACKAGE_NAME) + 1:]
+                elif target == PACKAGE_NAME:
+                    target = ""
+                imports[alias.asname or alias.name.split(".")[0]] = [target, None]
+        elif isinstance(node, ast.ImportFrom):
+            base: list[str]
+            if node.level:
+                base = pkg_parts[:len(pkg_parts) - (node.level - 1)] \
+                    if node.level <= len(pkg_parts) + 1 else []
+                if node.module:
+                    base = base + node.module.split(".")
+            else:
+                mod = node.module or ""
+                if mod == PACKAGE_NAME:
+                    base = []
+                elif mod.startswith(PACKAGE_NAME + "."):
+                    base = mod[len(PACKAGE_NAME) + 1:].split(".")
+                else:
+                    base = ["\x00ext", mod]    # external marker
+            for alias in node.names:
+                imports[alias.asname or alias.name] = [".".join(base), alias.name]
+
+    return {"version": SUMMARY_VERSION, "module": module, "relpath": relpath,
+            "functions": functions, "classes": classes, "imports": imports}
+
+
+class Program:
+    """Linked whole-program view over module summaries."""
+
+    def __init__(self, summaries: dict[str, dict[str, Any]]):
+        # relpath -> summary
+        self.summaries = summaries
+        self.by_module: dict[str, dict[str, Any]] = {
+            s["module"]: s for s in summaries.values()}
+        # Global class index: name -> (module, class record). First wins;
+        # duplicate class names across modules disable unique lookups.
+        self.classes: dict[str, tuple[str, dict[str, Any]] | None] = {}
+        # method name -> {"Class.method" qualified ids by (module, qlocal)}
+        method_owners: dict[str, list[tuple[str, str]]] = {}
+        for s in summaries.values():
+            for cname, crec in s["classes"].items():
+                if cname in self.classes:
+                    self.classes[cname] = None          # ambiguous
+                else:
+                    self.classes[cname] = (s["module"], crec)
+                for m in crec["methods"]:
+                    method_owners.setdefault(m, []).append(
+                        (s["module"], f"{cname}.{m}"))
+        self.unique_methods: dict[str, tuple[str, str]] = {
+            m: owners[0] for m, owners in method_owners.items()
+            if len(owners) == 1 and m not in _DEVIRT_DENY}
+
+    # -- lookups -----------------------------------------------------------
+    def fn(self, module: str, qlocal: str) -> dict[str, Any] | None:
+        s = self.by_module.get(module)
+        if s is None:
+            return None
+        return s["functions"].get(qlocal)
+
+    def relpath(self, module: str) -> str:
+        return self.by_module[module]["relpath"]
+
+    def resolve_call(self, module: str, caller_qlocal: str,
+                     name: str) -> tuple[str, str] | None:
+        """(module, qlocal) of the project function a call by ``name`` from
+        ``caller_qlocal`` refers to, or None (external / dynamic)."""
+        s = self.by_module.get(module)
+        if s is None:
+            return None
+        caller = s["functions"].get(caller_qlocal, {})
+        cls = caller.get("class")
+        parts = name.split(".")
+
+        # self.X(...) → method of the enclosing class (here or a base).
+        if parts[0] == "self":
+            if len(parts) != 2 or cls is None:
+                return None
+            return self._resolve_method(module, cls, parts[1])
+
+        if parts[0] == "?":                      # dynamic receiver
+            return self._devirt(parts[-1])
+
+        # Bare name: nested def in an enclosing scope, module function,
+        # class in this module (constructor), or import.
+        if len(parts) == 1:
+            scope = caller_qlocal.split(".")
+            for depth in range(len(scope), 0, -1):
+                cand = ".".join(scope[:depth] + [name])
+                if cand in s["functions"]:
+                    return module, cand
+            if name in s["functions"]:
+                return module, name
+            if name in s["classes"]:
+                return self._ctor(module, name)
+            imp = s["imports"].get(name)
+            if imp is not None:
+                return self._resolve_import(imp, None)
+            return None
+
+        # Dotted: resolve the root, then descend one level.
+        root, rest = parts[0], parts[1:]
+        if root in s["classes"] and len(rest) == 1:
+            return self._resolve_method(module, root, rest[0])
+        imp = s["imports"].get(root)
+        if imp is not None:
+            return self._resolve_import(imp, rest)
+        # obj.method(...) with an unresolvable receiver → devirtualize by
+        # project-unique method name.
+        return self._devirt(parts[-1])
+
+    def _ctor(self, module: str, cls: str) -> tuple[str, str] | None:
+        rec = self.by_module[module]["classes"].get(cls)
+        if rec and "__init__" in rec["methods"]:
+            return module, f"{cls}.__init__"
+        return None
+
+    def _resolve_method(self, module: str, cls: str,
+                        meth: str) -> tuple[str, str] | None:
+        seen = set()
+        queue = [(module, cls)]
+        while queue:
+            mod, cname = queue.pop()
+            if (mod, cname) in seen:
+                continue
+            seen.add((mod, cname))
+            s = self.by_module.get(mod)
+            rec = s["classes"].get(cname) if s else None
+            if rec is None:
+                entry = self.classes.get(cname)
+                if entry is None:
+                    continue
+                mod, rec = entry[0], entry[1]
+            if meth in rec["methods"]:
+                return mod, f"{cname}.{meth}"
+            for base in rec.get("bases", []):
+                queue.append((mod, base.split(".")[-1]))
+        return None
+
+    def _devirt(self, meth: str) -> tuple[str, str] | None:
+        return self.unique_methods.get(meth)
+
+    def _resolve_import(self, imp: list[str | None],
+                        rest: list[str] | None) -> tuple[str, str] | None:
+        mod, attr = imp[0], imp[1]
+        if mod is not None and mod.startswith("\x00ext"):
+            return None
+        rest = list(rest or [])
+        if attr is not None:
+            # from M import A: A may be a submodule, class, or function.
+            sub = f"{mod}.{attr}" if mod else attr
+            if sub in self.by_module:
+                mod = sub
+            elif mod in self.by_module:
+                s = self.by_module[mod]
+                if attr in s["classes"]:
+                    if not rest:
+                        return self._ctor(mod, attr)
+                    if len(rest) == 1:
+                        return self._resolve_method(mod, attr, rest[0])
+                    return None
+                if not rest and attr in s["functions"]:
+                    return mod, attr
+                return None
+            else:
+                return None
+        if mod not in self.by_module:
+            return None
+        s = self.by_module[mod]
+        if not rest:
+            return None
+        if len(rest) == 1:
+            if rest[0] in s["functions"]:
+                return mod, rest[0]
+            if rest[0] in s["classes"]:
+                return self._ctor(mod, rest[0])
+            return None
+        if len(rest) == 2 and rest[0] in s["classes"]:
+            return self._resolve_method(mod, rest[0], rest[1])
+        return None
+
+    # -- pass 1: transitive async-blocking --------------------------------
+    def _blocking_findings(self) -> list[Finding]:
+        findings: list[Finding] = []
+        for s in self.summaries.values():
+            rel = s["relpath"]
+            if not rel.startswith(SERVING_DIRS):
+                continue
+            for qlocal, fn in s["functions"].items():
+                if fn["is_async"]:
+                    findings.extend(
+                        self._chase_blocking(s["module"], qlocal, fn))
+        return findings
+
+    def _chase_blocking(self, module: str, qlocal: str,
+                        fn: dict[str, Any]) -> list[Finding]:
+        """BFS over call edges from one async entry; shortest chain per
+        terminal blocking site, depth ≥ 1 (depth 0 is the lexical rule)."""
+        entry_rel = self.relpath(module)
+        findings: list[Finding] = []
+        reported: set[tuple[str, int]] = set()
+        # queue entries: (module, qlocal, chain) where chain is hops so far.
+        seen = {(module, qlocal)}
+        queue: deque = deque()
+        for call in fn["calls"]:
+            tgt = self.resolve_call(module, qlocal, call["name"])
+            if tgt is None or tgt in seen:
+                continue
+            seen.add(tgt)
+            hop = ChainHop(entry_rel, call["line"],
+                           f"{_pretty(qlocal)} calls {_pretty(tgt[1])} "
+                           f"({self.relpath(tgt[0])}:{self._line(tgt)})")
+            queue.append((tgt, (hop,)))
+        while queue:
+            (mod, ql), chain = queue.popleft()
+            callee = self.fn(mod, ql)
+            if callee is None or len(chain) > 8:
+                continue
+            rel = self.relpath(mod)
+            if callee["is_async"] and rel.startswith(SERVING_DIRS) \
+                    and callee["blocking"]:
+                continue        # lexically flagged at its own site already
+            for line, msg in callee["blocking"]:
+                key = (rel, line)
+                if key in reported:
+                    continue
+                reported.add(key)
+                full = chain + (ChainHop(rel, line, msg),)
+                entry_fn = _pretty(qlocal)
+                findings.append(Finding(
+                    rule="async-blocking", path=entry_rel,
+                    line=chain[0].line, col=0,
+                    message=(f"async {entry_fn}() reaches blocking call "
+                             f"through {len(chain)} call hop(s): {msg} "
+                             f"[{rel}:{line}] — offload the helper via "
+                             f"asyncio.to_thread or make the chain async"),
+                    chain=full))
+            for call in callee["calls"]:
+                tgt = self.resolve_call(mod, ql, call["name"])
+                if tgt is None or tgt in seen:
+                    continue
+                seen.add(tgt)
+                hop = ChainHop(rel, call["line"],
+                               f"{_pretty(ql)} calls {_pretty(tgt[1])} "
+                               f"({self.relpath(tgt[0])}:{self._line(tgt)})")
+                queue.append((tgt, chain + (hop,)))
+        return findings
+
+    def _line(self, ref: tuple[str, str]) -> int:
+        fn = self.fn(*ref)
+        return fn["line"] if fn else 0
+
+    # -- pass 2: guarded-by inference --------------------------------------
+    def _guard_index(self) -> dict[str, dict[str, str]]:
+        """class name -> {attr: guard} across the whole tree (ambiguous
+        class names keep their first-seen guards — same-name classes with
+        different guard sets would be a design smell the per-file rule
+        still covers)."""
+        idx: dict[str, dict[str, str]] = {}
+        for s in self.summaries.values():
+            for cname, crec in s["classes"].items():
+                if crec["guards"]:
+                    idx.setdefault(cname, {}).update(crec["guards"])
+        return idx
+
+    def _thread_reachable(self) -> dict[tuple[str, str], tuple[ChainHop, ...]]:
+        """(module, qlocal) -> dispatch chain for every function reachable
+        from a thread-dispatch site, whole-program."""
+        reach: dict[tuple[str, str], tuple[ChainHop, ...]] = {}
+        queue: deque = deque()
+        for s in self.summaries.values():
+            rel = s["relpath"]
+            for qlocal, fn in s["functions"].items():
+                for ref_name, line in fn["thread_refs"]:
+                    tgt = self.resolve_call(s["module"], qlocal, ref_name)
+                    if tgt is None:
+                        continue
+                    hop = ChainHop(rel, line,
+                                   f"{_pretty(qlocal)} dispatches "
+                                   f"{_pretty(tgt[1])} to a worker thread")
+                    if tgt not in reach:
+                        reach[tgt] = (hop,)
+                        queue.append(tgt)
+        while queue:
+            mod, ql = queue.popleft()
+            fn = self.fn(mod, ql)
+            if fn is None:
+                continue
+            base_chain = reach[(mod, ql)]
+            if len(base_chain) > 8:
+                continue
+            rel = self.relpath(mod)
+            for call in fn["calls"]:
+                tgt = self.resolve_call(mod, ql, call["name"])
+                if tgt is None or tgt in reach:
+                    continue
+                hop = ChainHop(rel, call["line"],
+                               f"{_pretty(ql)} calls {_pretty(tgt[1])}")
+                reach[tgt] = base_chain + (hop,)
+                queue.append(tgt)
+        return reach
+
+    def _guard_findings(self) -> list[Finding]:
+        guards = self._guard_index()
+        if not guards:
+            return []
+        reach = self._thread_reachable()
+        findings: list[Finding] = []
+        seen: set[tuple[str, int, str]] = set()
+        for s in self.summaries.values():
+            rel = s["relpath"]
+            for qlocal, fn in s["functions"].items():
+                in_init = qlocal.endswith("__init__")
+                for acc in fn["accesses"]:
+                    cls_guards = guards.get(acc["cls"])
+                    if not cls_guards:
+                        continue
+                    guard = cls_guards.get(acc["attr"])
+                    if guard is None:
+                        continue
+                    key = (rel, acc["line"], acc["attr"])
+                    if key in seen:
+                        continue
+                    target = f"{acc['recv']}.{acc['attr']}"
+                    if guard == "loop":
+                        chain = reach.get((s["module"], qlocal))
+                        if chain is None:
+                            continue
+                        seen.add(key)
+                        full = chain + (ChainHop(
+                            rel, acc["line"],
+                            f"{_pretty(qlocal)} touches {target} "
+                            f"(guarded-by: loop) off the event loop"),)
+                        findings.append(Finding(
+                            rule="lock-discipline", path=rel,
+                            line=acc["line"], col=0,
+                            message=(f"{target} of class {acc['cls']} is "
+                                     f"`guarded-by: loop` (event-loop thread "
+                                     f"only) but {_pretty(qlocal)}() is "
+                                     f"reachable from a worker-thread "
+                                     f"dispatch ({len(chain)} hop(s))"),
+                            chain=full))
+                        continue
+                    # Lock guard. Same-class sites are the per-file rule's
+                    # (already enforced); the program pass adds EXTERNAL
+                    # accesses through typed parameters/locals.
+                    if acc["recv"] == "self" or in_init:
+                        continue
+                    held = {l.split(".")[-1] for l in acc["locks"]
+                            if l.split(".")[0] == acc["recv"] or "." not in l}
+                    if guard in held:
+                        continue
+                    seen.add(key)
+                    kind = "mutates" if acc["mut"] else "reads"
+                    findings.append(Finding(
+                        rule="lock-discipline", path=rel,
+                        line=acc["line"], col=0,
+                        message=(f"{_pretty(qlocal)}() {kind} {target} of "
+                                 f"class {acc['cls']} which is `guarded-by: "
+                                 f"{guard}` — external access must hold "
+                                 f"`with {acc['recv']}.{guard}` (or go "
+                                 f"through the class's own accessors)"),
+                        chain=(ChainHop(rel, acc["line"],
+                                        f"unguarded external {kind[:-1]} of "
+                                        f"{acc['cls']}.{acc['attr']}"),)))
+        return findings
+
+    # -- pass 3: httpx timeout dataflow ------------------------------------
+    def _timeout_findings(self) -> list[Finding]:
+        # Seed taint: client-like args passed at call sites in providers/.
+        tainted: dict[tuple[str, str, str], tuple[ChainHop, ...]] = {}
+        queue: deque = deque()
+
+        def taint(tgt: tuple[str, str], param: str,
+                  chain: tuple[ChainHop, ...]) -> None:
+            key = (tgt[0], tgt[1], param)
+            if key in tainted:
+                return
+            tainted[key] = chain
+            queue.append(key)
+
+        for s in self.summaries.values():
+            if not s["relpath"].startswith(PROVIDER_DIRS):
+                continue
+            rel = s["relpath"]
+            for qlocal, fn in s["functions"].items():
+                for call in fn["calls"]:
+                    if not call.get("client_args"):
+                        continue
+                    tgt = self.resolve_call(s["module"], qlocal, call["name"])
+                    if tgt is None:
+                        continue
+                    callee = self.fn(*tgt)
+                    if callee is None:
+                        continue
+                    for pos in call["client_args"]:
+                        pname = _param_at(callee, pos)
+                        if pname is None:
+                            continue
+                        hop = ChainHop(
+                            rel, call["line"],
+                            f"{_pretty(qlocal)} passes an httpx client to "
+                            f"{_pretty(tgt[1])}({pname}=…) "
+                            f"[{self.relpath(tgt[0])}:{callee['line']}]")
+                        taint(tgt, pname, (hop,))
+
+        findings: list[Finding] = []
+        reported: set[tuple[str, int]] = set()
+        while queue:
+            mod, ql, param = queue.popleft()
+            chain = tainted[(mod, ql, param)]
+            fn = self.fn(mod, ql)
+            if fn is None or len(chain) > 8:
+                continue
+            rel = self.relpath(mod)
+            # Direct unsafe use of the tainted parameter.
+            for recv, method, line in fn["httpx_bare"]:
+                if recv != param or (rel, line) in reported:
+                    continue
+                if rel.startswith(PROVIDER_DIRS) and "client" in param.lower():
+                    continue        # the lexical rule flags this receiver
+                reported.add((rel, line))
+                full = chain + (ChainHop(
+                    rel, line, f"{_pretty(ql)} calls {param}.{method}() "
+                               f"without timeout="),)
+                findings.append(Finding(
+                    rule="timeout-discipline", path=rel, line=line, col=0,
+                    message=(f"httpx {method}() on client parameter "
+                             f"{param!r} without explicit timeout= — the "
+                             f"client flowed in from "
+                             f"{chain[0].path}:{chain[0].line}; pass the "
+                             f"deadline-capped timeout through"),
+                    chain=full))
+            # Propagate: tainted param passed onward.
+            for call in fn["calls"]:
+                pargs = call.get("param_args") or {}
+                fwd = [(pos, p) for pos, p in pargs.items() if p == param]
+                if not fwd:
+                    continue
+                tgt = self.resolve_call(mod, ql, call["name"])
+                if tgt is None:
+                    continue
+                callee = self.fn(*tgt)
+                if callee is None:
+                    continue
+                for pos, _ in fwd:
+                    pname = _param_at(callee,
+                                      int(pos) if pos.isdigit() else pos)
+                    if pname is None:
+                        continue
+                    hop = ChainHop(rel, call["line"],
+                                   f"{_pretty(ql)} forwards {param} to "
+                                   f"{_pretty(tgt[1])}({pname}=…)")
+                    key = (tgt[0], tgt[1], pname)
+                    if key not in tainted:
+                        tainted[key] = chain + (hop,)
+                        queue.append(key)
+        return findings
+
+    # -- driver -------------------------------------------------------------
+    def findings(self) -> list[Finding]:
+        out = (self._blocking_findings() + self._guard_findings()
+               + self._timeout_findings())
+        out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return out
+
+
+def _pretty(qlocal: str) -> str:
+    return qlocal
+
+
+def _param_at(fn: dict[str, Any], pos: Any) -> str | None:
+    params = [p for p in fn["params"] if p not in ("self", "cls")]
+    if isinstance(pos, str) and not pos.isdigit():
+        return pos if pos in fn["params"] or pos in params else None
+    i = int(pos)
+    if 0 <= i < len(params):
+        return params[i]
+    return None
+
+
+def summarize_source(source: str, path: str | Path,
+                     base: Path | None = None) -> dict[str, Any] | None:
+    """Parse + summarize one file; None when it doesn't parse (the lexical
+    pass reports the syntax error)."""
+    relpath = package_relpath(path, base)
+    try:
+        tree = ast.parse(source, filename=str(path))
+    except SyntaxError:
+        return None
+    return summarize_module(tree, source, relpath)
+
+
+def analyze_program(paths: Iterable[str | Path],
+                    summaries: dict[str, dict[str, Any]] | None = None,
+                    report_only: set[str] | None = None) -> list[Finding]:
+    """Whole-program findings over ``paths`` (files and/or directory
+    roots). Pre-computed ``summaries`` (e.g. cache-loaded, keyed by
+    relpath) are used as-is; missing files are parsed fresh. Per-file
+    ``# graftlint: disable=`` suppressions apply to the findings exactly
+    as they do for lexical rules. ``report_only`` (relpaths) filters the
+    report without shrinking the analyzed world — the ``--changed`` mode."""
+    summaries = dict(summaries or {})
+    sources: dict[str, str] = {}
+    for root in paths:
+        rootp = Path(root)
+        base = rootp if rootp.is_dir() else rootp.parent
+        for f in iter_python_files(rootp):
+            rel = package_relpath(f, base)
+            try:
+                src = f.read_text()
+            except OSError:
+                continue
+            sources[rel] = src
+            if rel not in summaries:
+                summ = summarize_source(src, f, base)
+                if summ is not None:
+                    summaries[rel] = summ
+    program = Program(summaries)
+    findings = program.findings()
+    out: list[Finding] = []
+    known = {"async-blocking", "lock-discipline", "timeout-discipline"}
+    supp_cache: dict[str, Suppressions] = {}
+    for f in findings:
+        if report_only is not None and f.path not in report_only:
+            continue
+        src = sources.get(f.path)
+        if src is not None:
+            supp = supp_cache.get(f.path)
+            if supp is None:
+                supp = Suppressions.parse(src, known)
+                supp_cache[f.path] = supp
+            if supp.is_suppressed(f):
+                continue
+        out.append(f)
+    return out

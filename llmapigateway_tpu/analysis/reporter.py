@@ -1,4 +1,11 @@
-"""graftlint reporters: human text and machine JSON."""
+"""graftlint reporters: human text, machine JSON, and SARIF 2.1.0.
+
+SARIF is the interchange format code-review tooling actually ingests
+(GitHub code scanning, VS Code SARIF viewer, tools/lint_report.py):
+interprocedural findings ship their call chains both as
+``relatedLocations`` (every file:line hop, clickable) and as a
+``codeFlows`` thread flow (the ordered path a viewer can step through).
+"""
 from __future__ import annotations
 
 import json
@@ -6,6 +13,10 @@ from collections import Counter
 from typing import Iterable
 
 from .core import Finding
+
+SARIF_VERSION = "2.1.0"
+SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/"
+                "master/Schemata/sarif-schema-2.1.0.json")
 
 
 def render_text(findings: list[Finding], *, checked_files: int) -> str:
@@ -26,6 +37,61 @@ def render_json(findings: list[Finding], *, checked_files: int) -> str:
         "count": len(findings),
         "checked_files": checked_files,
     }, indent=2)
+
+
+def _sarif_location(path: str, line: int, col: int = 0,
+                    message: str | None = None) -> dict:
+    loc: dict = {"physicalLocation": {
+        "artifactLocation": {"uri": path},
+        "region": {"startLine": max(1, line),
+                   "startColumn": max(1, col + 1)}}}
+    if message:
+        loc["message"] = {"text": message}
+    return loc
+
+
+def render_sarif(findings: list[Finding], *, checked_files: int,
+                 rules: Iterable = ()) -> str:
+    rule_meta = [{"id": r.name,
+                  "shortDescription": {"text": r.description}}
+                 for r in rules]
+    known_ids = {r["id"] for r in rule_meta}
+    for f in findings:                      # meta-rules (parse-error etc.)
+        if f.rule not in known_ids:
+            known_ids.add(f.rule)
+            rule_meta.append({"id": f.rule,
+                              "shortDescription": {"text": f.rule}})
+    results = []
+    for f in findings:
+        res: dict = {
+            "ruleId": f.rule,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [_sarif_location(f.path, f.line, f.col)],
+        }
+        if f.chain:
+            res["relatedLocations"] = [
+                _sarif_location(h.path, h.line, message=h.note)
+                for h in f.chain]
+            res["codeFlows"] = [{"threadFlows": [{"locations": [
+                {"location": _sarif_location(h.path, h.line, message=h.note)}
+                for h in f.chain]}]}]
+        results.append(res)
+    doc = {
+        "$schema": SARIF_SCHEMA,
+        "version": SARIF_VERSION,
+        "runs": [{
+            "tool": {"driver": {
+                "name": "graftlint",
+                "informationUri":
+                    "https://llmapigateway-tpu.local/tools/README.md",
+                "rules": rule_meta,
+            }},
+            "properties": {"checkedFiles": checked_files},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=2)
 
 
 def render_rules(rules: Iterable) -> str:

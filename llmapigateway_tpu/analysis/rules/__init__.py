@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from ..core import Rule
 from .async_blocking import RULE as ASYNC_BLOCKING
+from .exception_hygiene import RULE as EXCEPTION_HYGIENE
 from .lock_discipline import RULE as LOCK_DISCIPLINE
 from .metric_discipline import RULE as METRIC_DISCIPLINE
 from .secret_hygiene import RULE as SECRET_HYGIENE
@@ -25,6 +26,7 @@ ALL_RULES: tuple[Rule, ...] = (
     SSE_PROTOCOL,
     TIMEOUT_DISCIPLINE,
     METRIC_DISCIPLINE,
+    EXCEPTION_HYGIENE,
 )
 
 RULES_BY_NAME: dict[str, Rule] = {r.name: r for r in ALL_RULES}

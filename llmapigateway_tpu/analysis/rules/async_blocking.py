@@ -49,11 +49,44 @@ _BLOCKING_METHODS = {
 }
 
 
+def classify_blocking_call(node: ast.Call) -> str | None:
+    """The message describing why this Call blocks the event loop, or None
+    if it doesn't. Shared between the lexical rule (direct calls inside
+    ``async def``) and the whole-program pass (analysis/program.py), so the
+    two can never disagree about what counts as blocking."""
+    name = call_name(node)
+    if name is not None:
+        if name in _BLOCKING_CALLS:
+            return _BLOCKING_CALLS[name]
+        root = name.split(".")[0]
+        if root in _BLOCKING_MODULE_ROOTS and "." in name:
+            return _BLOCKING_MODULE_ROOTS[root]
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        if func.attr in _BLOCKING_METHODS:
+            return _BLOCKING_METHODS[func.attr]
+        if func.attr == "item" and not node.args and not node.keywords:
+            return (".item() forces a device->host sync on the event loop; "
+                    "fetch via asyncio.to_thread")
+    if (isinstance(func, ast.Name) and func.id == "open"
+            and not _is_async_open(node)):
+        return ("open() is synchronous file I/O on the event loop; use "
+                "asyncio.to_thread")
+    if (isinstance(func, ast.Name) and func.id in ("float", "int")
+            and node.args
+            and references_module(node.args[0], _JAX_ROOTS)):
+        return (f"{func.id}() of a JAX array is a device->host sync on the "
+                "event loop; fetch via asyncio.to_thread")
+    return None
+
+
 class AsyncBlockingRule(Rule):
     name = "async-blocking"
     description = ("blocking calls (time.sleep, sync sqlite3/file I/O, "
                    "requests.*, JAX host syncs, .item()/float(arr)) inside "
-                   "async def bodies in the serving layers")
+                   "async def bodies in the serving layers; the "
+                   "whole-program pass extends this transitively through "
+                   "sync helpers in any module")
     dirs = ("server", "routing", "providers")
 
     def check(self, tree: ast.Module, source: str,
@@ -75,47 +108,9 @@ class AsyncBlockingRule(Rule):
                 continue
             stack.extend(ast.iter_child_nodes(node))
             if isinstance(node, ast.Call):
-                self._check_call(node, relpath, findings)
-
-    def _check_call(self, node: ast.Call, relpath: str,
-                    findings: list[Finding]) -> None:
-        name = call_name(node)
-        if name is not None:
-            if name in _BLOCKING_CALLS:
-                findings.append(self.finding(
-                    relpath, node, _BLOCKING_CALLS[name]))
-                return
-            root = name.split(".")[0]
-            if root in _BLOCKING_MODULE_ROOTS and "." in name:
-                findings.append(self.finding(
-                    relpath, node, _BLOCKING_MODULE_ROOTS[root]))
-                return
-        func = node.func
-        if isinstance(func, ast.Attribute):
-            if func.attr in _BLOCKING_METHODS:
-                findings.append(self.finding(
-                    relpath, node, _BLOCKING_METHODS[func.attr]))
-                return
-            if func.attr == "item" and not node.args and not node.keywords:
-                findings.append(self.finding(
-                    relpath, node,
-                    ".item() forces a device->host sync on the event loop; "
-                    "fetch via asyncio.to_thread"))
-                return
-        if (isinstance(func, ast.Name) and func.id == "open"
-                and not _is_async_open(node)):
-            findings.append(self.finding(
-                relpath, node,
-                "open() is synchronous file I/O on the event loop; use "
-                "asyncio.to_thread"))
-            return
-        if (isinstance(func, ast.Name) and func.id in ("float", "int")
-                and node.args
-                and references_module(node.args[0], _JAX_ROOTS)):
-            findings.append(self.finding(
-                relpath, node,
-                f"{func.id}() of a JAX array is a device->host sync on the "
-                "event loop; fetch via asyncio.to_thread"))
+                msg = classify_blocking_call(node)
+                if msg is not None:
+                    findings.append(self.finding(relpath, node, msg))
 
 
 def _is_async_open(node: ast.Call) -> bool:

@@ -1,0 +1,101 @@
+"""exception-hygiene: swallowed errors in the serving-critical layers.
+
+A silent ``except Exception: pass`` in the router's fallback loop, a
+provider, or the engine turns a real failure (dead upstream, deleted
+device buffer, poisoned cache) into a mystery the operator debugs from
+symptom instead of cause — the gateway's reliability layer (breakers,
+deadline 504s, typed overload shedding) only works when failures surface
+as *classified* errors. The contract this rule pins for ``routing/``,
+``providers/`` and ``engine/``:
+
+* **no bare ``except:``** — it traps ``KeyboardInterrupt`` /
+  ``SystemExit`` / ``asyncio.CancelledError`` and breaks cooperative
+  cancellation (the local provider's cancel-on-disconnect path relies on
+  CancelledError propagating).
+* **no swallowed broad handlers** — ``except Exception`` (or
+  ``BaseException``, alone or in a tuple) must do at least one of: log
+  (any ``logger.*``/``logging.*`` call), re-raise, or convert to a typed
+  error (construct something named ``*Error``/``*Overloaded``). A body of
+  ``pass``/``...``/bare ``return``/``continue`` hides the failure.
+
+Narrow handlers (``except httpx.TimeoutException``, ``except
+sqlite3.Error``) are exempt: catching a *specific* exception is itself
+the classification. Documented intentional swallows take a
+``# graftlint: disable=exception-hygiene`` with a justification.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Rule
+from ._util import dotted_name
+
+_BROAD = frozenset({"Exception", "BaseException"})
+
+_LOG_METHODS = frozenset({"debug", "info", "warning", "warn", "error",
+                          "exception", "critical", "log"})
+
+
+def _is_broad(handler_type: ast.AST | None) -> bool:
+    if handler_type is None:
+        return False
+    if isinstance(handler_type, ast.Tuple):
+        return any(_is_broad(e) for e in handler_type.elts)
+    name = dotted_name(handler_type)
+    return name is not None and name.split(".")[-1] in _BROAD
+
+
+def _handles_the_error(handler: ast.ExceptHandler) -> bool:
+    """True when the handler body logs, re-raises, or converts to a typed
+    error somewhere in its subtree."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Attribute) and func.attr in _LOG_METHODS:
+                base = func.value
+                base_name = (base.attr if isinstance(base, ast.Attribute)
+                             else base.id if isinstance(base, ast.Name) else "")
+                if base_name and ("log" in base_name.lower()
+                                  or base_name == "logging"):
+                    return True
+            name = dotted_name(func)
+            if name and (name.split(".")[-1].endswith("Error")
+                         or name.split(".")[-1].endswith("Overloaded")):
+                return True
+    return False
+
+
+class ExceptionHygieneRule(Rule):
+    name = "exception-hygiene"
+    description = ("no bare `except:`; `except Exception` in routing/, "
+                   "providers/, engine/ must log, re-raise, or convert to "
+                   "a typed *Error — silent swallows hide real failures "
+                   "from the reliability layer")
+    dirs = ("routing", "providers", "engine")
+
+    def check(self, tree: ast.Module, source: str,
+              relpath: str) -> list[Finding]:
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                findings.append(self.finding(
+                    relpath, node,
+                    "bare `except:` traps KeyboardInterrupt/SystemExit/"
+                    "CancelledError and breaks cooperative cancellation; "
+                    "catch a specific exception (or `except Exception` "
+                    "with logging)"))
+                continue
+            if _is_broad(node.type) and not _handles_the_error(node):
+                findings.append(self.finding(
+                    relpath, node,
+                    "`except Exception` swallows the failure silently: "
+                    "log it, re-raise, or convert it to a typed *Error so "
+                    "the router/breaker layer can classify it"))
+        return findings
+
+
+RULE = ExceptionHygieneRule()

@@ -1,0 +1,635 @@
+"""Runtime asyncio sanitizer: the dynamic half of graftlint v2.
+
+Static analysis (rules/, program.py) proves what it can from source; this
+module catches the classes of event-loop bug that are *structurally*
+invisible to an AST — a callback that blocks because of data-dependent
+control flow, a guarded field mutated through an alias the call graph
+couldn't resolve, a task or span leaked by an exception path nobody
+wrote a test for. Three detectors, all cheap enough to run under the
+entire tier-1 suite (tests/conftest.py installs them session-wide, so
+every chaos/obs/engine test doubles as a race hunt):
+
+* :class:`StallDetector` — wraps ``asyncio.events.Handle._run`` to time
+  every callback/coroutine step on the loop. A step exceeding the
+  threshold is a violation; a watchdog thread samples the loop thread's
+  stack *mid-stall* (``sys._current_frames``), so the report shows where
+  the loop was stuck, not just which callback was slow.
+
+* :class:`GuardTracker` — runtime enforcement of the ``# guarded-by:``
+  convention the static lock-discipline rule checks lexically. Tracked
+  objects get their annotated container fields wrapped in checking
+  proxies (dict/list subclasses; a delegating proxy for ``asyncio.Queue``
+  / ``sqlite3.Connection``) and their class ``__setattr__`` patched:
+  ``guarded-by: loop`` fields must only be touched from the owning
+  (instrumentation-time) thread, ``guarded-by: <lock>`` fields only while
+  the named lock is held. Guard maps are parsed from the class's own
+  source annotations, so the static and dynamic layers read one truth.
+
+* leak checks — :func:`leaked_tasks` (pending tasks on a loop at
+  teardown) and :func:`leaked_spans` (finished traces holding open
+  non-root spans in obs/trace ring buffers).
+
+Violations are recorded, never raised: a sanitizer that throws from
+``__setattr__`` turns a diagnosed race into an undiagnosable crash. The
+test harness asserts the violation list is empty at session end.
+"""
+from __future__ import annotations
+
+import ast
+import asyncio
+import functools
+import inspect
+import logging
+import sys
+import threading
+import time
+import traceback
+import weakref
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Any, Callable, Iterable
+
+from .rules.lock_discipline import _GUARDED_RE
+
+logger = logging.getLogger(__name__)
+
+MAX_VIOLATIONS = 200            # cap: a hot broken path must not OOM the run
+DEFAULT_STALL_THRESHOLD_S = 0.25
+
+
+@dataclass
+class Violation:
+    kind: str                   # "stall" | "guard" | "task-leak" | "span-leak"
+    message: str
+    stack: str = ""
+    thread: str = ""
+
+    def render(self) -> str:
+        head = f"[{self.kind}] {self.message}"
+        if self.thread:
+            head += f" (thread={self.thread})"
+        if self.stack:
+            head += "\n" + "\n".join(
+                "    " + l for l in self.stack.rstrip().splitlines())
+        return head
+
+
+# -- guard-map extraction (one truth with the static rule) -------------------
+
+@functools.lru_cache(maxsize=None)
+def guard_map_for(cls: type) -> dict[str, str]:
+    """{attr: guard} for a class, parsed from the ``# guarded-by:``
+    annotations in its defining module's source. Empty when the source is
+    unavailable (frozen/REPL classes) — instrumentation degrades to a
+    no-op rather than failing."""
+    mod = sys.modules.get(cls.__module__)
+    if mod is None:
+        return {}
+    try:
+        src = inspect.getsource(mod)
+        tree = ast.parse(src)
+    except (OSError, TypeError, SyntaxError):
+        return {}
+    lines = src.splitlines()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls.__name__:
+            guards: dict[str, str] = {}
+            for sub in ast.walk(node):
+                if not isinstance(sub, (ast.Assign, ast.AnnAssign)):
+                    continue
+                targets = (sub.targets if isinstance(sub, ast.Assign)
+                           else [sub.target])
+                for t in targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        for ln in range(sub.lineno,
+                                        getattr(sub, "end_lineno",
+                                                sub.lineno) + 1):
+                            if ln <= len(lines):
+                                m = _GUARDED_RE.search(lines[ln - 1])
+                                if m:
+                                    guards[t.attr] = m.group(1)
+                                    break
+            return guards
+    return {}
+
+
+# -- stall detection ----------------------------------------------------------
+
+class StallDetector:
+    """Times every ``Handle._run`` on every loop in the process; records a
+    violation for steps exceeding ``threshold_s``. ``clock`` is injectable
+    for fake-clock unit tests (:meth:`timed_call` exercises the exact
+    production code path without a real loop)."""
+
+    def __init__(self, threshold_s: float = DEFAULT_STALL_THRESHOLD_S,
+                 clock: Callable[[], float] = time.monotonic,
+                 watchdog: bool = True):
+        self.threshold_s = threshold_s
+        self._clock = clock
+        self._watchdog_enabled = watchdog
+        self.violations: list[Violation] = []
+        self.installed = False
+        self._orig_run: Callable | None = None
+        self._paused = 0
+        # thread id -> (start time, description) for steps in flight; the
+        # watchdog samples these. GIL-atomic dict ops only.
+        self._active: dict[int, tuple[float, str]] = {}
+        self._stacks: dict[int, str] = {}
+        self._watchdog: threading.Thread | None = None
+        self._stop_watchdog = threading.Event()
+
+    # -- the timed path (shared by the patch and the unit tests) ----------
+    def timed_call(self, fn: Callable[[], Any], describe: str = "",
+                   handle: Any = None) -> Any:
+        """``describe`` may be empty when ``handle`` is given: the
+        description is then built lazily, only for over-threshold steps —
+        per-callback string building is measurable overhead on the hot
+        loop and perturbs the timing the detector is meant to observe."""
+        tid = threading.get_ident()
+        t0 = self._clock()
+        self._active[tid] = (t0, describe)
+        try:
+            return fn()
+        finally:
+            self._active.pop(tid, None)
+            dt = self._clock() - t0
+            if dt >= self.threshold_s and not self._paused:
+                desc = describe or (_describe_handle(handle)
+                                    if handle is not None else repr(fn))
+                self._record(desc, dt, self._stacks.pop(tid, ""))
+
+    def _record(self, desc: str, dt: float, stack: str) -> None:
+        if len(self.violations) >= MAX_VIOLATIONS:
+            return
+        self.violations.append(Violation(
+            kind="stall",
+            message=(f"event-loop callback ran {dt * 1000.0:.1f} ms "
+                     f"(threshold {self.threshold_s * 1000.0:.0f} ms): "
+                     f"{desc[:300]}"),
+            stack=stack, thread=threading.current_thread().name))
+
+    # -- install/uninstall -------------------------------------------------
+    def install(self) -> None:
+        if self.installed:
+            return
+        self._orig_run = asyncio.events.Handle._run
+        detector = self
+        orig = self._orig_run
+
+        def _run(handle):        # noqa: ANN001 — asyncio internal signature
+            return detector.timed_call(lambda: orig(handle), handle=handle)
+
+        asyncio.events.Handle._run = _run
+        self.installed = True
+        if self._watchdog_enabled:
+            self._stop_watchdog.clear()
+            self._watchdog = threading.Thread(
+                target=self._watch, name="graft-sanitizer-watchdog",
+                daemon=True)
+            self._watchdog.start()
+
+    def uninstall(self) -> None:
+        if not self.installed:
+            return
+        asyncio.events.Handle._run = self._orig_run
+        self.installed = False
+        self._stop_watchdog.set()
+        if self._watchdog is not None:
+            self._watchdog.join(timeout=2.0)
+            self._watchdog = None
+
+    def _watch(self) -> None:
+        """Sample the stack of any thread whose current step has already
+        exceeded the threshold — captured mid-stall, this is the actual
+        blocking site, which the post-hoc duration report can't show."""
+        poll = max(0.01, min(0.25, self.threshold_s / 4.0))
+        while not self._stop_watchdog.wait(poll):
+            if self._paused or not self._active:
+                continue
+            now = self._clock()
+            for tid, (t0, _desc) in list(self._active.items()):
+                if now - t0 < self.threshold_s or tid in self._stacks:
+                    continue
+                frame = sys._current_frames().get(tid)
+                if frame is not None:
+                    self._stacks[tid] = "".join(
+                        traceback.format_stack(frame, limit=12))
+
+    @contextmanager
+    def pause(self):
+        self._paused += 1
+        try:
+            yield
+        finally:
+            self._paused -= 1
+
+
+def _describe_handle(handle) -> str:
+    return _describe_callback(getattr(handle, "_callback", None))
+
+
+def _describe_callback(cb) -> str:
+    """Describe a callback from metadata only — NEVER ``repr(cb)``. A
+    bound method's repr calls ``repr(__self__)``, and instance reprs are
+    not side-effect-free: aiohttp's ``ClientResponse.__repr__`` reads a
+    ``@reify`` (cache-on-first-access) property, so an eager repr here
+    caches it unpopulated and corrupts the object under test."""
+    if cb is None:
+        return "<handle>"
+    if isinstance(cb, functools.partial):
+        return f"partial({_describe_callback(cb.func)})"
+    func = getattr(cb, "__func__", cb)      # unwrap bound methods
+    name = (getattr(func, "__qualname__", None)
+            or getattr(func, "__name__", None) or type(cb).__name__)
+    owner = getattr(cb, "__self__", None)
+    if owner is not None and not isinstance(owner, type):
+        name = f"{name} of {type(owner).__name__}"
+    mod = getattr(func, "__module__", None)
+    return f"{mod}:{name}" if mod else name
+
+
+# -- guarded-field tracking ---------------------------------------------------
+
+class _GuardInfo:
+    __slots__ = ("tracker", "obj", "guards", "owner_ident")
+
+    def __init__(self, tracker: "GuardTracker", obj: Any,
+                 guards: dict[str, str], owner_ident: int | None):
+        self.tracker = tracker
+        self.obj = obj
+        self.guards = guards
+        # For `guarded-by: loop` fields: the event-loop thread that owns
+        # the object. None = not yet known — objects are often BUILT off
+        # the loop (ProviderRegistry constructs engines in a worker
+        # thread), so ownership binds lazily to the first thread that
+        # touches a loop-guarded field while actually running an event
+        # loop (see GuardTracker._check).
+        self.owner_ident = owner_ident
+
+
+def _running_loop_here() -> bool:
+    try:
+        asyncio.get_running_loop()
+        return True
+    except RuntimeError:
+        return False
+
+
+def _lock_held(lock: Any) -> bool | None:
+    """Best-effort: is this lock held (by anyone)? None = can't tell."""
+    is_owned = getattr(lock, "_is_owned", None)
+    if callable(is_owned):               # RLock: ownership, not just held
+        try:
+            return bool(is_owned())
+        except TypeError:
+            pass
+    locked = getattr(lock, "locked", None)
+    if callable(locked):                 # threading.Lock / asyncio.Lock
+        return bool(locked())
+    return None
+
+
+class GuardedDict(dict):
+    """dict that runs the guard check before every mutation."""
+    __slots__ = ("_graft_check",)
+
+    def __init__(self, data: dict, check: Callable[[str], None]):
+        super().__init__(data)
+        self._graft_check = check
+
+    def __reduce__(self):                # pickling drops the proxy
+        return (dict, (dict(self),))
+
+
+class GuardedList(list):
+    """list that runs the guard check before every mutation."""
+    __slots__ = ("_graft_check",)
+
+    def __init__(self, data: list, check: Callable[[str], None]):
+        super().__init__(data)
+        self._graft_check = check
+
+    def __reduce__(self):
+        return (list, (list(self),))
+
+
+def _checked(method_name: str):
+    def op(self, *a, **kw):
+        self._graft_check(f".{method_name}()")
+        return getattr(super(type(self), self), method_name)(*a, **kw)
+    op.__name__ = method_name
+    return op
+
+
+for _m in ("__setitem__", "__delitem__", "pop", "popitem", "clear",
+           "update", "setdefault"):
+    setattr(GuardedDict, _m, _checked(_m))
+for _m in ("__setitem__", "__delitem__", "__iadd__", "append", "extend",
+           "insert", "pop", "remove", "clear", "sort", "reverse"):
+    setattr(GuardedList, _m, _checked(_m))
+
+
+class _CheckedDelegate:
+    """Attribute-delegating proxy for stateful non-container guarded
+    values (asyncio.Queue, sqlite3.Connection): mutator method calls run
+    the guard check, everything else passes straight through."""
+
+    _MUTATORS = frozenset({
+        "put_nowait", "get_nowait", "put", "get", "task_done",
+        "execute", "executemany", "executescript", "commit", "rollback",
+        "close",
+    })
+
+    def __init__(self, target: Any, check: Callable[[str], None]):
+        object.__setattr__(self, "_graft_target", target)
+        object.__setattr__(self, "_graft_check", check)
+
+    def __getattr__(self, name: str) -> Any:
+        target = object.__getattribute__(self, "_graft_target")
+        val = getattr(target, name)
+        if name in _CheckedDelegate._MUTATORS and callable(val):
+            check = object.__getattribute__(self, "_graft_check")
+
+            def checked(*a, **kw):
+                check(f".{name}()")
+                return val(*a, **kw)
+            return checked
+        return val
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        setattr(object.__getattribute__(self, "_graft_target"), name, value)
+
+    def __repr__(self) -> str:
+        return f"<guarded {object.__getattribute__(self, '_graft_target')!r}>"
+
+
+class GuardTracker:
+    """Tracks objects whose classes carry ``# guarded-by:`` annotations
+    and records violations of the declared guard at mutation time."""
+
+    def __init__(self):
+        self.violations: list[Violation] = []
+        self._patched: list[tuple[type, Any]] = []
+        self._patched_types: set[type] = set()
+        self._paused = 0
+
+    # -- tracking ----------------------------------------------------------
+    def track(self, obj: Any, guards: dict[str, str] | None = None,
+              owner_ident: int | None = None) -> Any:
+        """Instrument one object. ``guards`` defaults to the class's
+        source annotations. ``owner_ident`` pins the loop-owner thread for
+        ``guarded-by: loop`` fields; by default it binds lazily to the
+        first toucher that is running an event loop."""
+        if guards is None:
+            guards = guard_map_for(type(obj))
+        if not guards:
+            return obj
+        info = _GuardInfo(self, obj, dict(guards), owner_ident)
+        self._ensure_patched(type(obj))
+        object.__setattr__(obj, "_graft_guard_info", info)
+        for attr in guards:
+            if attr in obj.__dict__:
+                val = obj.__dict__[attr]
+                wrapped = self._wrap(info, attr, val)
+                if wrapped is not val:
+                    object.__setattr__(obj, attr, wrapped)
+        return obj
+
+    def _wrap(self, info: "_GuardInfo", attr: str, val: Any) -> Any:
+        def check(op: str, _info=info, _attr=attr) -> None:
+            self._check(_info, _attr, op)
+        if type(val) is dict:
+            return GuardedDict(val, check)
+        if type(val) is list:
+            return GuardedList(val, check)
+        if isinstance(val, asyncio.Queue) or \
+                type(val).__module__ == "sqlite3":
+            return _CheckedDelegate(val, check)
+        return val
+
+    def _ensure_patched(self, cls: type) -> None:
+        if cls in self._patched_types:
+            return
+        had_own = "__setattr__" in cls.__dict__
+        orig = cls.__setattr__
+        tracker = self
+
+        def __setattr__(obj, name, value):
+            info = obj.__dict__.get("_graft_guard_info")
+            if info is not None and name in info.guards:
+                tracker._check(info, name, "rebind")
+                value = tracker._wrap(info, name, value)
+            orig(obj, name, value)
+
+        cls.__setattr__ = __setattr__
+        self._patched.append((cls, orig if had_own else None))
+        self._patched_types.add(cls)
+
+    def untrack_all(self) -> None:
+        for cls, orig in self._patched:
+            if orig is None:
+                del cls.__setattr__      # fall back to the inherited slot
+            else:
+                cls.__setattr__ = orig
+        self._patched.clear()
+        self._patched_types.clear()
+
+    # -- the check ---------------------------------------------------------
+    def _check(self, info: "_GuardInfo", attr: str, op: str) -> None:
+        if self._paused or len(self.violations) >= MAX_VIOLATIONS:
+            return
+        guard = info.guards.get(attr)
+        cls_name = type(info.obj).__name__
+        if guard == "loop":
+            ident = threading.get_ident()
+            if info.owner_ident is None:
+                # First touch wins ownership — but only from a thread that
+                # is actually running an event loop (construction and
+                # direct sync-test pokes don't bind).
+                if _running_loop_here():
+                    info.owner_ident = ident
+                return
+            if ident != info.owner_ident:
+                self._violate(
+                    f"{cls_name}.{attr} is `guarded-by: loop` (owner "
+                    f"thread only) but was mutated ({op}) from "
+                    f"{threading.current_thread().name}")
+            return
+        lock = getattr(info.obj, guard, None)
+        if lock is None:
+            return
+        held = _lock_held(lock)
+        if held is False:
+            self._violate(
+                f"{cls_name}.{attr} is `guarded-by: {guard}` but was "
+                f"mutated ({op}) without the lock held")
+
+    def _violate(self, message: str) -> None:
+        self.violations.append(Violation(
+            kind="guard", message=message,
+            stack="".join(traceback.format_stack(limit=10)[:-2]),
+            thread=threading.current_thread().name))
+
+    @contextmanager
+    def pause(self):
+        self._paused += 1
+        try:
+            yield
+        finally:
+            self._paused -= 1
+
+
+# -- leak detection -----------------------------------------------------------
+
+def leaked_tasks(loop: asyncio.AbstractEventLoop) -> list[Violation]:
+    """Tasks still pending on ``loop`` — at teardown, anything here was
+    started and never awaited/cancelled (the 'Task was destroyed but it
+    is pending' class of bug, caught deterministically)."""
+    out: list[Violation] = []
+    try:
+        tasks = asyncio.all_tasks(loop)
+    except RuntimeError:
+        return out
+    for t in tasks:
+        if t.done():
+            continue
+        coro = getattr(t, "get_coro", lambda: None)()
+        out.append(Violation(
+            kind="task-leak",
+            message=f"task still pending at teardown: {coro!r}"))
+    return out
+
+
+def leaked_spans(tracers: Iterable[Any]) -> list[Violation]:
+    """Finished traces holding open non-root spans, across tracer ring
+    buffers — a leaked span makes every later trace read a lie."""
+    out: list[Violation] = []
+    for tracer in tracers:
+        traces = getattr(tracer, "_traces", None)
+        if traces is None:
+            continue
+        for trace in list(traces.values()):
+            root = trace.root
+            if root.end is None:
+                continue                     # still in flight: not a leak
+            for sp in root.walk():
+                if sp is not root and sp.end is None:
+                    out.append(Violation(
+                        kind="span-leak",
+                        message=(f"trace {trace.request_id!r} finished "
+                                 f"with open span {sp.name!r} "
+                                 f"(layer={sp.layer})")))
+    return out
+
+
+# -- the facade ---------------------------------------------------------------
+
+class AsyncioSanitizer:
+    """Bundles the three detectors behind one install/report surface —
+    what tests/conftest.py activates for the tier-1 suite."""
+
+    def __init__(self, stall_threshold_s: float = DEFAULT_STALL_THRESHOLD_S,
+                 clock: Callable[[], float] = time.monotonic,
+                 watchdog: bool = True):
+        self.stall = StallDetector(stall_threshold_s, clock=clock,
+                                   watchdog=watchdog)
+        self.guards = GuardTracker()
+        self.leaks: list[Violation] = []
+        self._init_patches: list[tuple[type, Any]] = []
+        self.tracers: "weakref.WeakSet[Any]" = weakref.WeakSet()
+
+    # -- lifecycle ---------------------------------------------------------
+    def install(self) -> None:
+        self.stall.install()
+
+    def uninstall(self) -> None:
+        self.stall.uninstall()
+        self.guards.untrack_all()
+        for cls, orig in self._init_patches:
+            cls.__init__ = orig
+        self._init_patches.clear()
+
+    @property
+    def active(self) -> bool:
+        return self.stall.installed
+
+    # -- instrumentation ---------------------------------------------------
+    def track(self, obj: Any, guards: dict[str, str] | None = None,
+              owner_ident: int | None = None) -> Any:
+        return self.guards.track(obj, guards, owner_ident)
+
+    def register_tracer(self, tracer: Any) -> None:
+        self.tracers.add(tracer)
+
+    def instrument_classes(self, classes: Iterable[type]) -> None:
+        """Wrap each class's ``__init__`` so every future instance is
+        tracked (guard annotations) or registered (trace ring buffers)
+        automatically. Undone by :meth:`uninstall`."""
+        for cls in classes:
+            orig_init = cls.__init__
+            sanitizer = self
+
+            def make_init(orig_init=orig_init, cls=cls):
+                @functools.wraps(orig_init)
+                def __init__(obj, *args, **kwargs):
+                    orig_init(obj, *args, **kwargs)
+                    try:
+                        if hasattr(obj, "_traces"):
+                            sanitizer.register_tracer(obj)
+                        else:
+                            sanitizer.track(obj)
+                    except Exception:       # sanitizer must never break SUT
+                        logger.exception("sanitizer track() failed for %s",
+                                         cls.__name__)
+                return __init__
+
+            cls.__init__ = make_init()
+            self._init_patches.append((cls, orig_init))
+
+    # -- reporting ---------------------------------------------------------
+    def check_leaks(self, loop: asyncio.AbstractEventLoop | None = None) -> list[Violation]:
+        found: list[Violation] = []
+        if loop is not None:
+            found.extend(leaked_tasks(loop))
+        found.extend(leaked_spans(self.tracers))
+        self.leaks.extend(found)
+        return found
+
+    def violations(self) -> list[Violation]:
+        return list(self.stall.violations) + list(self.guards.violations) \
+            + list(self.leaks)
+
+    def report(self) -> str:
+        v = self.violations()
+        if not v:
+            return "asyncio sanitizer: clean"
+        lines = [f"asyncio sanitizer: {len(v)} violation(s)"]
+        lines += [x.render() for x in v]
+        return "\n".join(lines)
+
+    @contextmanager
+    def pause(self):
+        with self.stall.pause(), self.guards.pause():
+            yield
+
+
+def default_instrumented_classes() -> list[type]:
+    """The gateway classes the tier-1 suite instruments: every layer that
+    carries ``# guarded-by:`` annotations, plus the tracer (span leaks).
+    Imported lazily so proxy-only deployments can use the sanitizer
+    without JAX."""
+    from ..config.loader import ConfigLoader
+    from ..db.rotation import RotationDB
+    from ..db.usage import UsageDB
+    from ..obs.trace import Tracer
+    from ..routing.router import ProviderRegistry
+    classes: list[type] = [ConfigLoader, RotationDB, UsageDB, Tracer,
+                           ProviderRegistry]
+    try:
+        from ..engine.engine import InferenceEngine
+        classes.append(InferenceEngine)
+    except Exception:                       # JAX-less deployment
+        logger.info("engine unavailable; sanitizer skips it", exc_info=True)
+    return classes

@@ -159,7 +159,8 @@ class LocalProvider(Provider):
             try:
                 hint = self.engine.retry_after_hint_s()
             except Exception:       # stats must never break shedding
-                pass
+                logger.debug("retry-after hint unavailable; shedding "
+                             "without one", exc_info=True)
             return None, CompletionError(str(e), status=503,
                                          kind="overload", retry_after_s=hint)
         except Exception as e:

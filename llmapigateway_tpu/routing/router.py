@@ -83,7 +83,11 @@ class ProviderRegistry:
         self._cache: dict[str, tuple[str, Provider]] = {}   # guarded-by: _lock
         self._lock = asyncio.Lock()
         self._name_locks: dict[str, asyncio.Lock] = {}      # guarded-by: _lock
-        self._retiring: set[asyncio.Task] = set()
+        # Retire-task bookkeeping is touched only from loop-side code
+        # (create_task callbacks, close()) — never from the _build worker
+        # thread; the annotation makes graftlint v2's thread-reachability
+        # pass and the runtime sanitizer both enforce that.
+        self._retiring: set[asyncio.Task] = set()           # guarded-by: loop
         self._closed = False
 
     async def get(self, name: str) -> Provider | None:

@@ -9,6 +9,7 @@ file (``rules_editor.py:80-92``). Validation failures return a structured
 """
 from __future__ import annotations
 
+import asyncio
 import logging
 
 from aiohttp import web
@@ -17,12 +18,17 @@ from ..config.schemas import ConfigError
 
 logger = logging.getLogger(__name__)
 
+# Raw config reads/writes go through asyncio.to_thread: ConfigLoader's
+# read_raw/write_raw are synchronous file I/O (+ json5 parse on save) and
+# would otherwise stall every in-flight SSE stream — graftlint v2's
+# transitive async-blocking pass chases exactly this chain.
+
 
 async def get_rules_text(request: web.Request) -> web.Response:
     gw = request.app["gateway"]
     try:
-        return web.Response(text=gw.loader.read_raw("rules"),
-                            content_type="text/plain")
+        text = await asyncio.to_thread(gw.loader.read_raw, "rules")
+        return web.Response(text=text, content_type="text/plain")
     except OSError as e:
         return web.json_response({"detail": str(e)}, status=404)
 
@@ -30,8 +36,8 @@ async def get_rules_text(request: web.Request) -> web.Response:
 async def get_providers_text(request: web.Request) -> web.Response:
     gw = request.app["gateway"]
     try:
-        return web.Response(text=gw.loader.read_raw("providers"),
-                            content_type="text/plain")
+        text = await asyncio.to_thread(gw.loader.read_raw, "providers")
+        return web.Response(text=text, content_type="text/plain")
     except OSError as e:
         return web.json_response({"detail": str(e)}, status=404)
 
@@ -40,7 +46,7 @@ async def _save(request: web.Request, which: str) -> web.Response:
     gw = request.app["gateway"]
     text = await request.text()
     try:
-        gw.loader.write_raw(which, text)
+        await asyncio.to_thread(gw.loader.write_raw, which, text)
     except ConfigError as e:
         return web.json_response(
             {"detail": f"validation failed; file not saved", "errors": [str(e)]},

@@ -80,6 +80,39 @@ def _shared_loop():
     return _loop
 
 
+@pytest.fixture(scope="session", autouse=True)
+def graft_sanitizer():
+    """Runtime asyncio sanitizer (graftlint v2, ISSUE 5) armed for the
+    ENTIRE tier-1 suite: every chaos/obs/engine test doubles as a race
+    hunt. Three detectors (analysis/sanitizer.py): an event-loop stall
+    detector (any callback step over the threshold, with a mid-stall
+    stack sample), a guarded-field tracker enforcing the `# guarded-by:`
+    annotations on live engine/router/config/db objects, and task/span
+    leak checks at session teardown. Violations fail the session — the
+    dynamic analog of test_graftlint's static live-tree gate.
+
+    GRAFT_SANITIZER=0 disables; GRAFT_SANITIZER_STALL_S tunes the stall
+    threshold (default 5 s: far above any legitimate await-to-await step,
+    below a wedged loop; XLA compiles run in worker threads and never
+    count, but first-call tracing inside an async test body can
+    legitimately take seconds on a cold CPU cache)."""
+    if os.environ.get("GRAFT_SANITIZER", "1") == "0":
+        yield None
+        return
+    from llmapigateway_tpu.analysis.sanitizer import (
+        AsyncioSanitizer, default_instrumented_classes)
+    san = AsyncioSanitizer(stall_threshold_s=float(
+        os.environ.get("GRAFT_SANITIZER_STALL_S", "5.0")))
+    san.install()
+    san.instrument_classes(default_instrumented_classes())
+    yield san
+    loop = _loop if _loop is not None and not _loop.is_closed() else None
+    san.check_leaks(loop)
+    report = san.report()
+    san.uninstall()
+    assert not san.violations(), report
+
+
 @pytest.fixture(scope="session")
 def stop_engine():
     """Fixture-teardown helper: stop an engine ON THE SHARED LOOP so its

@@ -1,0 +1,13 @@
+"""BAD fixture: the pooled client flows into a helper module that drops
+timeout discipline — the per-file rule's receiver heuristic never sees it."""
+import httpx
+
+from ..util.httpio import fetch
+
+
+class P:
+    def __init__(self):
+        self._client = httpx.AsyncClient(timeout=5)
+
+    async def call(self, url):
+        return await fetch(self._client, url)

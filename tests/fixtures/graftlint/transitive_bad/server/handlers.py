@@ -1,0 +1,14 @@
+"""BAD fixture: async handlers whose blocking I/O hides behind helpers in
+another module — invisible to the per-file rule, caught by the
+whole-program pass with the full call chain."""
+from ..util.helpers import load_config, load_config_indirect
+
+
+async def get_config(request):
+    # Direct one-hop chain: helper does time.sleep + open() in util/.
+    return load_config()
+
+
+async def get_config_deep(request):
+    # Two-hop chain: wrapper → helper.
+    return load_config_indirect()

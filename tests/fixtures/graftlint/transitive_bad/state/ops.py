@@ -1,0 +1,22 @@
+"""BAD fixture: guarded-by escapes the per-file rule cannot see — external
+access through a typed parameter, and a loop-guarded field touched in a
+function reachable from a worker-thread dispatch."""
+import asyncio
+
+from .store import Store
+
+
+def evict(store: Store):
+    store._table.clear()            # external mutation without the lock
+
+
+def snapshot(store: Store):
+    return dict(store._table)       # external read without the lock
+
+
+class Runner:
+    async def go(self, store: Store):
+        await asyncio.to_thread(self._work, store)
+
+    def _work(self, store: Store):
+        store._loopstate.append(1)  # loop-only state, worker-thread reachable

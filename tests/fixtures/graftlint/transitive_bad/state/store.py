@@ -1,0 +1,9 @@
+"""Guarded state: the annotations the inference pass enforces tree-wide."""
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}            # guarded-by: _lock
+        self._loopstate = []        # guarded-by: loop

@@ -1,0 +1,11 @@
+"""Sync helpers that block — fine on a worker thread, fatal on the loop."""
+import time
+
+
+def load_config():
+    time.sleep(0.1)
+    return open("cfg.json").read()
+
+
+def load_config_indirect():
+    return load_config()

@@ -1,0 +1,6 @@
+"""Helper that makes the wire call — with no timeout, the deadline cap
+the provider computed never reaches httpx."""
+
+
+async def fetch(client, url):
+    return await client.post(url, json={})      # no timeout=

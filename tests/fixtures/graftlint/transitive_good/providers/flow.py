@@ -1,0 +1,14 @@
+"""GOOD fixture: the client flows into the helper WITH its timeout."""
+import httpx
+
+from ..util.httpio import fetch
+
+TIMEOUT = httpx.Timeout(30.0, connect=5.0)
+
+
+class P:
+    def __init__(self):
+        self._client = httpx.AsyncClient(timeout=TIMEOUT)
+
+    async def call(self, url):
+        return await fetch(self._client, url, timeout=TIMEOUT)

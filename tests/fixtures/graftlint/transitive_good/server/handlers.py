@@ -1,0 +1,16 @@
+"""GOOD fixture: same shape as transitive_bad, with the sanctioned
+patterns — blocking helpers offloaded by reference, never called on the
+loop."""
+import asyncio
+
+from ..util.helpers import load_config
+
+
+async def get_config(request):
+    # Passed by reference to the thread pool: no call edge, no block.
+    return await asyncio.to_thread(load_config)
+
+
+async def get_config_async(request):
+    await asyncio.sleep(0)
+    return {}

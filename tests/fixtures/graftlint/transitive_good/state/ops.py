@@ -1,0 +1,18 @@
+"""GOOD fixture: the same external/threaded shapes, done right."""
+import asyncio
+
+from .store import Store
+
+
+def evict(store: Store):
+    with store._lock:
+        store._table.clear()        # external mutation under the lock
+
+
+class Runner:
+    async def go(self, store: Store):
+        store._loopstate.append(1)          # loop side: fine
+        await asyncio.to_thread(self._work, store)
+
+    def _work(self, store: Store):
+        return store.snapshot()     # thread side uses the locked accessor

@@ -1,0 +1,14 @@
+"""Guarded state with the discipline intact: external access holds the
+lock; loop-guarded state is only touched on the loop side."""
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}            # guarded-by: _lock
+        self._loopstate = []        # guarded-by: loop
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._table)

@@ -1,0 +1,7 @@
+"""Sync helpers that block — only ever run on worker threads here."""
+import time
+
+
+def load_config():
+    time.sleep(0.1)
+    return open("cfg.json").read()

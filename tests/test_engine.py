@@ -684,8 +684,11 @@ async def test_queue_wait_and_clamp_surface_in_stats_under_load():
             await asyncio.sleep(0.005)
         assert probe.t_first_token is not None
         assert bg.finish_reason is None          # saturation was real
-        # Bounded interleave: the in-flight burst plus clamped rounds.
-        assert plan.decode_calls - bursts_at_submit <= 3, \
+        # Bounded interleave: at most the burst in flight at submit time
+        # plus one clamped round per prefill chunk (the probe spans 3).
+        # Anything above that means decode rounds ran unclamped between
+        # chunks — the starvation this clamp exists to prevent.
+        assert plan.decode_calls - bursts_at_submit <= 4, \
             f"probe waited {plan.decode_calls - bursts_at_submit} bursts"
         s = eng.stats()
         assert s["burst_busy_clamps"] >= 1

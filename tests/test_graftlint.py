@@ -388,6 +388,82 @@ def test_metric_discipline_exempts_the_tracer_module():
     assert "metric-discipline" in rules_hit(src, "obs/other.py")
 
 
+EXC_BAD = """
+    async def route(self, request):
+        try:
+            return await self._attempt(request)
+        except:                             # bare: traps CancelledError
+            return None
+
+    def drain(self):
+        try:
+            self._flush()
+        except Exception:
+            pass                            # swallowed silently
+
+    def probe(self):
+        try:
+            self._ping()
+        except (ValueError, Exception):     # broad via tuple, no handling
+            return None
+"""
+
+EXC_GOOD = """
+    import logging
+    logger = logging.getLogger(__name__)
+
+    def narrow(self):
+        try:
+            self._flush()
+        except ValueError:                  # specific: the classification
+            pass
+
+    def logged(self):
+        try:
+            self._flush()
+        except Exception:
+            logger.exception("flush failed (ignored)")
+
+    def reraised(self):
+        try:
+            self._flush()
+        except Exception as e:
+            raise RuntimeError("flush") from e
+
+    def typed(self):
+        try:
+            self._flush()
+        except Exception as e:
+            return CompletionError(str(e))
+
+    def typed_overload(self):
+        try:
+            self._admit()
+        except Exception as e:
+            raise EngineOverloaded(str(e))
+"""
+
+
+def test_exception_hygiene_fires_on_bad():
+    findings = lint(EXC_BAD, "routing/fixture.py")
+    assert {f.rule for f in findings} == {"exception-hygiene"}
+    msgs = " | ".join(f.message for f in findings)
+    assert "bare `except:`" in msgs
+    assert "swallows the failure silently" in msgs
+    assert len(findings) == 3
+
+
+def test_exception_hygiene_silent_on_good():
+    assert rules_hit(EXC_GOOD, "providers/fixture.py") == set()
+
+
+def test_exception_hygiene_scoped_to_serving_and_engine():
+    # server/ (and everywhere else outside routing/providers/engine) is
+    # not this rule's business.
+    assert "exception-hygiene" not in rules_hit(EXC_BAD, "server/fixture.py")
+    assert "exception-hygiene" in rules_hit(EXC_BAD, "engine/fixture.py")
+
+
 # -- suppressions -------------------------------------------------------------
 
 def test_trailing_suppression_is_line_scoped():
@@ -416,12 +492,13 @@ def test_standalone_suppression_is_file_scoped():
 
 
 def test_disable_all_and_unknown_rule_name():
+    # The stale suppression is assembled so linting THIS file doesn't see it.
     src = """
     # graftlint: disable=all
     import time
 
     async def handler(request):
-        time.sleep(0.1)  # graftlint: disable=no-such-rule
+        time.sleep(0.1)  # graft""" + """lint: disable=no-such-rule
     """
     findings = lint(src, "server/fixture.py")
     # The blocking call is suppressed, but the stale suppression name is
@@ -480,6 +557,47 @@ def test_live_codebase_is_clean():
         findings.extend(analyze_file(path, ALL_RULES))
     rendered = "\n".join(f.render() for f in findings)
     assert not findings, f"graftlint findings in the live tree:\n{rendered}"
+
+
+def test_live_codebase_program_clean():
+    """graftlint v2's whole-program pass (symbol table + call graph +
+    dataflow: transitive async-blocking, guarded-by inference, httpx
+    timeout flow) over the live tree: zero unsuppressed findings. This is
+    the gate that keeps 'one transitive call through a sync helper' from
+    quietly re-introducing an event-loop stall (ISSUE 5)."""
+    from llmapigateway_tpu.analysis import analyze_program
+    findings = analyze_program([PACKAGE_DIR])
+    rendered = "\n".join(f.render() for f in findings)
+    assert not findings, \
+        f"whole-program graftlint findings in the live tree:\n{rendered}"
+
+
+def test_live_codebase_program_pass_engages():
+    """The clean result above must not be vacuous: the program pass must
+    actually resolve cross-module chains on the live tree (entries exist,
+    the call graph links server/ handlers into config/, providers/ into
+    the engine)."""
+    from llmapigateway_tpu.analysis import iter_python_files, summarize_source
+    from llmapigateway_tpu.analysis.program import Program
+    summaries = {}
+    for path in iter_python_files(PACKAGE_DIR):
+        s = summarize_source(path.read_text(), path)
+        if s is not None:
+            summaries[s["relpath"]] = s
+    program = Program(summaries)
+    # The chain that motivated the pass: an async config handler resolving
+    # into ConfigLoader.read_raw across modules.
+    tgt = program.resolve_call("server.config_api", "get_rules_text",
+                               "?.read_raw")
+    assert tgt == ("config.loader", "ConfigLoader.read_raw")
+    # Guard annotations visible tree-wide.
+    guards = program._guard_index()
+    assert guards["InferenceEngine"]["_running"] == "loop"
+    assert guards["ConfigLoader"]["_providers"] == "_lock"
+    # Thread-dispatch reachability sees the engine's worker offloads.
+    reach = program._thread_reachable()
+    assert any(ql.startswith("InferenceEngine.")
+               for _, ql in reach), "engine worker dispatches must resolve"
 
 
 def test_live_codebase_annotations_engage():

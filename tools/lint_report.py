@@ -1,0 +1,136 @@
+"""Grouped findings table from graftlint SARIF output (ISSUE 5 tooling).
+
+``python -m llmapigateway_tpu.analysis --format sarif`` emits SARIF 2.1.0
+— the right interchange format for CI upload, the wrong one for a human
+scanning a review. This tool folds a SARIF document into a per-rule
+grouped report (text or markdown) with the interprocedural call chains
+rendered as indented hop lists, mirroring ``tools/trace_report.py``'s
+role for span trees:
+
+    python -m llmapigateway_tpu.analysis --format sarif > graftlint.sarif
+    python tools/lint_report.py graftlint.sarif
+    python tools/lint_report.py --format md graftlint.sarif   # PR comment
+
+Exit code mirrors the linter: 0 when the document holds no results,
+1 when it does — so CI can pipe the report AND keep the gate.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import OrderedDict
+from pathlib import Path
+
+
+def _location(res: dict) -> tuple[str, int, int]:
+    try:
+        phys = res["locations"][0]["physicalLocation"]
+        return (phys["artifactLocation"]["uri"],
+                int(phys["region"].get("startLine", 0)),
+                int(phys["region"].get("startColumn", 1)))
+    except (KeyError, IndexError, TypeError):
+        return ("?", 0, 1)
+
+
+def _chain(res: dict) -> list[tuple[str, int, str]]:
+    hops = []
+    for rel in res.get("relatedLocations", []) or []:
+        try:
+            phys = rel["physicalLocation"]
+            hops.append((phys["artifactLocation"]["uri"],
+                         int(phys["region"].get("startLine", 0)),
+                         str(rel.get("message", {}).get("text", ""))))
+        except (KeyError, TypeError):
+            continue
+    return hops
+
+
+def group_results(doc: dict) -> "OrderedDict[str, list[dict]]":
+    """rule id -> result rows, insertion-ordered by first appearance."""
+    grouped: "OrderedDict[str, list[dict]]" = OrderedDict()
+    for run in doc.get("runs", []):
+        for res in run.get("results", []):
+            uri, line, col = _location(res)
+            grouped.setdefault(str(res.get("ruleId", "?")), []).append({
+                "uri": uri, "line": line, "col": col,
+                "message": str(res.get("message", {}).get("text", "")),
+                "chain": _chain(res),
+            })
+    for rows in grouped.values():
+        rows.sort(key=lambda r: (r["uri"], r["line"], r["col"]))
+    return grouped
+
+
+def checked_files(doc: dict) -> int | None:
+    for run in doc.get("runs", []):
+        n = (run.get("properties") or {}).get("checkedFiles")
+        if n is not None:
+            return int(n)
+    return None
+
+
+def render_text(grouped: "OrderedDict[str, list[dict]]",
+                n_files: int | None) -> str:
+    lines: list[str] = []
+    total = sum(len(rows) for rows in grouped.values())
+    for rule, rows in sorted(grouped.items()):
+        lines.append(f"== {rule} ({len(rows)} finding(s)) ==")
+        for r in rows:
+            lines.append(f"  {r['uri']}:{r['line']}:{r['col']}: {r['message']}")
+            for i, (uri, ln, note) in enumerate(r["chain"], start=1):
+                lines.append(f"      {i}. {uri}:{ln}: {note}")
+        lines.append("")
+    files = f" across {n_files} file(s)" if n_files is not None else ""
+    if total:
+        lines.append(f"{total} finding(s) in {len(grouped)} rule(s){files}")
+    else:
+        lines.append(f"clean{files}")
+    return "\n".join(lines)
+
+
+def render_markdown(grouped: "OrderedDict[str, list[dict]]",
+                    n_files: int | None) -> str:
+    lines: list[str] = ["# graftlint report", ""]
+    total = sum(len(rows) for rows in grouped.values())
+    files = f" across {n_files} file(s)" if n_files is not None else ""
+    lines.append(f"**{total} finding(s)**{files}" if total
+                 else f"**clean**{files}")
+    for rule, rows in sorted(grouped.items()):
+        lines += ["", f"## `{rule}` ({len(rows)})", "",
+                  "| location | message |", "| --- | --- |"]
+        for r in rows:
+            msg = r["message"].replace("|", "\\|")
+            lines.append(f"| `{r['uri']}:{r['line']}` | {msg} |")
+            if r["chain"]:
+                hops = "<br>".join(
+                    f"{i}. `{uri}:{ln}` {note.replace('|', chr(92) + '|')}"
+                    for i, (uri, ln, note) in enumerate(r["chain"], start=1))
+                lines.append(f"|  ⤷ call chain | {hops} |")
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="render graftlint SARIF as a grouped report")
+    parser.add_argument("sarif", nargs="?", default="-",
+                        help="SARIF file ('-' = stdin)")
+    parser.add_argument("--format", choices=("text", "md"), default="text")
+    args = parser.parse_args(argv)
+
+    try:
+        raw = (sys.stdin.read() if args.sarif == "-"
+               else Path(args.sarif).read_text())
+        doc = json.loads(raw)
+    except (OSError, ValueError) as e:
+        print(f"cannot read SARIF: {e}", file=sys.stderr)
+        return 2
+
+    grouped = group_results(doc)
+    render = render_markdown if args.format == "md" else render_text
+    print(render(grouped, checked_files(doc)))
+    return 1 if grouped else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
