@@ -407,8 +407,11 @@ def measure_ttft_under_load(engine, args) -> dict:
 
     rng = np.random.default_rng(1)
     V = engine.model_cfg.vocab_size
-    bg_prompt = rng.integers(0, V, size=args.prompt_len).tolist()
-    probe_prompt = rng.integers(0, V, size=args.prompt_len).tolist()
+    # DISTINCT prompts per request: with the radix prefix cache on by
+    # default, a repeated prompt would serve probes 2..N warm (prefill
+    # skipped) and silently turn this phase's headline into warm TTFT —
+    # the shared-prefix rung measures that on purpose; this one stays
+    # cold, comparable with the r5b ladder.
 
     async def run() -> dict:
         await engine.start()
@@ -416,8 +419,9 @@ def measure_ttft_under_load(engine, args) -> dict:
         bg = []
         budget = engine.S - args.prompt_len - 8
         for _ in range(max(1, engine.B - 1)):
-            r = GenRequest(prompt_ids=list(bg_prompt), max_tokens=budget,
-                           temperature=0.0)
+            r = GenRequest(
+                prompt_ids=rng.integers(0, V, args.prompt_len).tolist(),
+                max_tokens=budget, temperature=0.0)
             await engine.submit(r)
             bg.append(r)
 
@@ -436,8 +440,9 @@ def measure_ttft_under_load(engine, args) -> dict:
 
         ttfts = []
         for _ in range(args.ttft_probes):
-            p = GenRequest(prompt_ids=list(probe_prompt), max_tokens=4,
-                           temperature=0.0)
+            p = GenRequest(
+                prompt_ids=rng.integers(0, V, args.prompt_len).tolist(),
+                max_tokens=4, temperature=0.0)
             t_sub = time.monotonic()
             await engine.submit(p)
             t_first = await first_token(p)
@@ -454,6 +459,105 @@ def measure_ttft_under_load(engine, args) -> dict:
             "ttft_probes": len(arr),
             "ttft_load_slots": len(bg),
         }
+
+    return asyncio.run(run())
+
+
+def shared_prefix_rung(args) -> dict:
+    """ISSUE 6 acceptance rung: warm-vs-cold TTFT on a shared-prefix
+    workload. Every request carries the same >=--shared-prefix-len-token
+    system prefix plus a unique tail; the first request pays full
+    prefill, later ones must hit the radix prefix cache. The "prefill
+    actually skipped" claim is asserted from ENGINE STATS (cached-token
+    totals + FaultPlan prefill-call counts), not wall clock alone."""
+    import asyncio
+    import numpy as np
+    from llmapigateway_tpu.config.schemas import LocalEngineConfig
+    from llmapigateway_tpu.engine.engine import (FaultPlan, GenRequest,
+                                                 InferenceEngine)
+
+    plen = max(32, args.shared_prefix_len)
+    tail_len = max(8, args.shared_prefix_tail)
+    # Keep the default page geometry when it leaves >= 2 shareable blocks
+    # in the prefix; shrink the page only when the operator asked for a
+    # prefix too short for it (smoke runs).
+    page = min(args.page_size, max(16, plen // 2))
+    seq = max(args.seq, plen + tail_len + 64)
+    chunk = min(512, max(32, plen // 4))
+    cfg = LocalEngineConfig(
+        preset=args.preset, dtype="bfloat16", max_batch_size=args.batch,
+        max_seq_len=seq, prefill_chunk=chunk, kv_layout="paged",
+        kv_page_size=page,
+        # Slack past full reservation so insert-on-release can retain the
+        # prefix instead of evicting it for the next admission.
+        kv_num_pages=(args.batch + 2) * -(-seq // page) + 1,
+        decode_burst=max(1, min(args.burst, 8)),
+        hbm_peak_gbps=args.peak_gbps, prewarm_sampler_variants=False)
+    t0 = time.monotonic()
+    engine = InferenceEngine(cfg)
+    note(f"shared-prefix engine init: {time.monotonic() - t0:.1f}s "
+         f"(page={page}, prefix={plen})")
+    if engine._prefix_cache is None:
+        raise RuntimeError("prefix cache inactive on the rung's engine")
+    engine.fault_plan = FaultPlan()
+    rng = np.random.default_rng(17)
+    V = engine.model_cfg.vocab_size
+    prefix = rng.integers(2, V, size=plen).tolist()
+
+    async def first_token(r: GenRequest) -> float:
+        while r.t_first_token is None and r.finish_reason is None:
+            await asyncio.sleep(0.002)
+        return r.t_first_token or time.monotonic()
+
+    async def one(ids, n_gen=8) -> float:
+        r = GenRequest(prompt_ids=ids, max_tokens=n_gen, temperature=0.0)
+        t_sub = time.monotonic()
+        await engine.submit(r)
+        ttft = 1000.0 * (await first_token(r) - t_sub)
+        async for _ in engine.stream(r):
+            pass
+        return ttft
+
+    async def run() -> dict:
+        await engine.start()
+        # Warm the compiled programs off the measured path: an unrelated
+        # full-length prompt (cold-shape prefill buckets + decode scans)
+        # and an unrelated short prompt (the warm tail's bucket).
+        await one(rng.integers(2, V, size=plen + tail_len).tolist())
+        await one(rng.integers(2, V, size=tail_len + 1).tolist())
+        calls0 = engine.fault_plan.prefill_calls
+        cold_ttft = await one(prefix + rng.integers(2, V,
+                                                    size=tail_len).tolist())
+        cold_calls = engine.fault_plan.prefill_calls - calls0
+        warm = []
+        warm_calls = []
+        for _ in range(max(1, args.shared_prefix_warm)):
+            calls0 = engine.fault_plan.prefill_calls
+            warm.append(await one(
+                prefix + rng.integers(2, V, size=tail_len).tolist()))
+            warm_calls.append(engine.fault_plan.prefill_calls - calls0)
+        stats = engine.stats()
+        await engine.stop()
+        arr = np.asarray(sorted(warm))
+        p50 = float(np.percentile(arr, 50))
+        out = {
+            "prefix_tokens": plen,
+            "page_size": page,
+            "cold_ttft_ms": round(cold_ttft, 1),
+            "warm_ttft_p50_ms": round(p50, 1),
+            "warm_ttft_p95_ms": round(float(np.percentile(arr, 95)), 1),
+            "warm_requests": len(warm),
+            "ttft_speedup": round(cold_ttft / max(1e-9, p50), 2),
+            # The structural proof prefill was SKIPPED, not just faster:
+            # chunk dispatches per request and the engine's own hit
+            # accounting.
+            "cold_prefill_calls": cold_calls,
+            "warm_prefill_calls_max": max(warm_calls),
+            "prefix_hits_total": stats.get("prefix_hits_total", 0),
+            "prefix_cached_tokens_total": stats.get(
+                "prefix_cached_tokens_total", 0),
+        }
+        return out
 
     return asyncio.run(run())
 
@@ -614,9 +718,11 @@ def main() -> None:
                     help="chained decode steps per host sync")
     ap.add_argument("--kv", default="both",
                     choices=["contiguous", "paged", "both"])
-    ap.add_argument("--page-size", type=int, default=128,
+    ap.add_argument("--page-size", type=int, default=256,
                     help="paged-KV page size (also the paged kernel's "
-                         "DMA block); the sweep measures the alternate too")
+                         "DMA block); 256 = the r5b sweep optimum and the "
+                         "engine default; the sweep measures the "
+                         "alternate too")
     ap.add_argument("--pages-per-block", type=int, default=1,
                     help="multi-page paged-kernel blocking (contiguous-"
                          "page runs per DMA); the paged phase also sweeps "
@@ -677,6 +783,16 @@ def main() -> None:
     ap.add_argument("--long-prompt", type=int, default=2048)
     ap.add_argument("--long-batch", type=int, default=4)
     ap.add_argument("--long-steps", type=int, default=64)
+    ap.add_argument("--shared-prefix", type=int, default=1,
+                    help="shared-prefix radix-cache rung: warm-vs-cold "
+                         "TTFT with a common prompt prefix (0 disables)")
+    ap.add_argument("--shared-prefix-len", type=int, default=512,
+                    help="common prefix length in tokens (the acceptance "
+                         "bar measures >=512)")
+    ap.add_argument("--shared-prefix-tail", type=int, default=32,
+                    help="unique per-request tail tokens after the prefix")
+    ap.add_argument("--shared-prefix-warm", type=int, default=6,
+                    help="warm requests measured after the cold one")
     ap.add_argument("--spec-draft", type=int, default=3,
                     help="speculative rung draft length (0 disables)")
     ap.add_argument("--spec-bursts", type=int, default=12)
@@ -1024,6 +1140,22 @@ def main() -> None:
                 ppb_sweep["best_pages_per_block"] = int(best)
                 ppb_sweep["best_tok_s"] = numeric[best]
             extra["paged_ppb_sweep"] = ppb_sweep
+
+    # -- phase 3a: shared-prefix radix-cache rung (ISSUE 6) ------------------
+    # Warm-vs-cold TTFT with a common >=512-token prefix: the acceptance
+    # bar is >=5x lower warm TTFT p50 with the skipped prefill PROVEN from
+    # engine stats (cached-token totals + prefill dispatch counts).
+    if args.shared_prefix and not over_budget("shared_prefix"):
+        try:
+            r = shared_prefix_rung(args)
+            extra["shared_prefix"] = r
+            note(f"shared-prefix: cold TTFT {r['cold_ttft_ms']} ms -> warm "
+                 f"p50 {r['warm_ttft_p50_ms']} ms ({r['ttft_speedup']}x, "
+                 f"{r['prefix_cached_tokens_total']} tokens served from "
+                 f"cache)")
+        except Exception as e:
+            errors.append(f"shared_prefix: {e!r}")
+            note(f"FAILED shared-prefix phase: {e!r}")
 
     # -- phase 3b: capacity crossover — paged vs dense at EQUAL KV HBM -------
     # BASELINE config 3's real argument for paged KV (VERDICT r4 item 3): a

@@ -632,4 +632,9 @@ def default_instrumented_classes() -> list[type]:
         classes.append(InferenceEngine)
     except Exception:                       # JAX-less deployment
         logger.info("engine unavailable; sanitizer skips it", exc_info=True)
+    # The radix prefix cache is jax-free but lives in the engine package;
+    # its `guarded-by: loop` counters must only mutate on the scheduler
+    # thread (ISSUE 6).
+    from ..engine.prefix_cache import RadixPrefixCache
+    classes.append(RadixPrefixCache)
     return classes

@@ -35,7 +35,13 @@ class LocalEngineConfig(BaseModel):
     mesh: dict[str, int] = Field(default_factory=dict)   # e.g. {"data":1,"model":8}
     max_batch_size: int = 8
     max_seq_len: int = 4096
-    kv_layout: str = "contiguous"   # "contiguous" | "paged"
+    # Paged is THE serving path since 0.19 (ISSUE 6): page-pool KV with
+    # admission-reservation backpressure, superpage kernel blocking, and
+    # the radix prefix cache all hang off it, and the page-size sweep
+    # closed the old paged-vs-contiguous decode gap (BENCH_SELF_r5b: the
+    # 256-page point beats contiguous). "contiguous" remains as a
+    # test-only numerical reference.
+    kv_layout: str = "paged"        # "paged" | "contiguous"
     # Page size doubles as the paged kernel's DMA block; 256 is the
     # measured optimum on v5e (2026-07-31 ladder: 1647.8 vs 1443.7
     # tok/s at 128, TinyLlama bs=8 — bench.py's paged_sweep re-measures
@@ -55,6 +61,18 @@ class LocalEngineConfig(BaseModel):
     # page ring, or non-divisible page geometry). Numerics are identical
     # for every value (bit-for-bit vs per-page kernels).
     kv_pages_per_block: int = 1
+    # Radix prefix cache over the paged pool (ISSUE 6): requests whose
+    # prompt prefix is resident (shared system prompts, multi-turn
+    # history) map the matched KV blocks straight into their page table
+    # and skip the matched span's prefill entirely; completed requests
+    # index their pages back on release (insert-on-release). Eviction is
+    # LRU-by-leaf under page pressure with in-flight pages refcount-
+    # pinned. Reuse granularity is kv_page_size × kv_pages_per_block
+    # tokens. Active on single-host, single-band, non-sliding-window
+    # paged engines; everywhere else the flag is inert. Hit accounting
+    # surfaces as `prompt_tokens_details.cached_tokens` in usage frames
+    # and as engine_prefix_cache_* series in /metrics.
+    prefix_cache: bool = True
     # Chip HBM peak (GB/s) for the engine's roofline telemetry: with this
     # set, stats()/the /v1/api/roofline endpoint report achieved GB/s as
     # a fraction of peak (v5e: 819). 0 = unknown — absolute achieved_gbps

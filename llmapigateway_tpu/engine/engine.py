@@ -34,11 +34,16 @@ Design (SURVEY.md §2b "Serving scheduler", §7 steps 5-6):
 * Per-slot sampling params live in device arrays; sampling is part of the
   decode program (no host round-trip per token beyond the sampled ids).
 
-Two KV layouts, selected by ``kv_layout``: the dense per-slot cache
-(models/llama.py ``KVCache``) and the paged pool
-(ops/paged_attention.py ``PagedKVCache`` + engine/paged.py allocator) where
-admission reserves pages for a request's whole lifetime — page exhaustion
-is backpressure at admission, never a mid-generation failure.
+The serving KV layout is the paged pool (ops/paged_attention.py
+``PagedKVCache`` + engine/paged.py allocator): admission reserves pages
+for a request's whole lifetime — page exhaustion is backpressure at
+admission, never a mid-generation failure — and the radix prefix cache
+(engine/prefix_cache.py) reuses resident KV across requests: a prompt
+whose prefix is resident maps the matched blocks into its page table and
+starts prefill at the match boundary, skipping the matched span's FLOPs
+outright (insert-on-release / LRU-by-leaf eviction / refcount pinning).
+``kv_layout="contiguous"`` keeps the dense per-slot cache
+(models/llama.py ``KVCache``) as a test-only numerical reference.
 
 Two independent int8 precision knobs (models/quant.py): ``quant`` stores
 every matmul weight as per-channel int8 (W8A8 on the MXU's native int8
@@ -127,6 +132,13 @@ class GenRequest:
     # Filled by the engine:
     slot: int = -1
     prefill_pos: int = 0
+    # Prefix-cache hit accounting (ISSUE 6): tokens whose prefill was
+    # skipped because their KV blocks were resident, the radix nodes
+    # pinned for this request's lifetime, and the lookup's wall cost
+    # (None = the cache was never consulted — disabled or bypassed).
+    cached_tokens: int = 0
+    prefix_nodes: list = field(default_factory=list)
+    prefix_lookup_ms: float | None = None
     generated: list[int] = field(default_factory=list)
     out_queue: asyncio.Queue = field(default_factory=asyncio.Queue)
     detok: IncrementalDetokenizer | None = None
@@ -213,6 +225,11 @@ class InferenceEngine:
         if engine_cfg.kv_layout not in ("contiguous", "paged"):
             raise ValueError(f"unknown kv_layout {engine_cfg.kv_layout!r}")
         self.paged = engine_cfg.kv_layout == "paged"
+        # Effective page size, clamped to the cache extent: a page larger
+        # than S would waste a whole-page tail per slot (with paged now
+        # the DEFAULT layout, small test/dev engines would otherwise carry
+        # 256-token pages for 64-token contexts).
+        self.kv_page = max(1, min(engine_cfg.kv_page_size, self.S))
         self._swa_ring_pages = 0        # set by the paged+SWA init branch
         self._swa_margin = 0            # in-flight burst margin, tokens
         # Sequence parallelism (SURVEY.md §5 long-context): with a `seq`
@@ -228,11 +245,11 @@ class InferenceEngine:
                 # POSITION-BANDED allocation (engine/paged.py) so every
                 # chip's S-shard of the gathered dense view reads only
                 # local pages; band boundaries must fall on pages.
-                if self.S % (self.seq_n * self.cfg.kv_page_size):
+                if self.S % (self.seq_n * self.kv_page):
                     raise ValueError(
                         f"paged × seq needs max_seq_len {self.S} divisible "
                         f"by seq × page = "
-                        f"{self.seq_n * self.cfg.kv_page_size}")
+                        f"{self.seq_n * self.kv_page}")
                 # (SWA × seq — paged or not — is rejected by the
                 # sliding-window guardrail below.)
             if self.S % self.seq_n:
@@ -264,7 +281,7 @@ class InferenceEngine:
         # Paged layout: the page table rides the command stream (followers
         # have no allocator), sized here so the wire width is fixed.
         from ..parallel.multihost import HostBridge
-        page = self.cfg.kv_page_size
+        page = self.kv_page
         self._bridge = HostBridge(
             self.B, self.prefill_chunk,
             table_slots=(self.S + page - 1) // page if self.paged else 0)
@@ -443,12 +460,13 @@ class InferenceEngine:
     def _init_state(self) -> None:
         c = self.model_cfg
         self.kv_ppb = 1          # multi-page kernel blocking (paged only)
+        self._prefix_cache = None       # guarded-by: loop
         if self.paged:
             from ..parallel.sharding import paged_cache_sharding
             from ..ops.paged_attention import PagedKVCache
             from .paged import PageAllocator
 
-            page = self.cfg.kv_page_size
+            page = self.kv_page
             per_slot = (self.S + page - 1) // page
             n_bands = self.seq_n if self.seq_n > 1 else 1
             # Sliding-window RING reservation (single host/stage/band):
@@ -515,6 +533,21 @@ class InferenceEngine:
             self.allocator = PageAllocator(num_pages, page, self.B, self.S,
                                            n_bands=n_bands,
                                            pages_per_block=self.kv_ppb)
+            # Radix prefix cache (ISSUE 6): cross-request KV reuse over
+            # the pool, block = one superpage run so the multi-page
+            # kernels apply to shared pages unchanged. Gated to the
+            # geometries where page identity is stable for a sequence's
+            # lifetime: single-band (a banded pool's pages are
+            # chip-local), non-SWA (ring rotation re-targets pages;
+            # windowed attention never re-reads old prefixes anyway),
+            # single-host (followers replay the broadcast table but hold
+            # no allocator/cache state to mirror the index).
+            if (self.cfg.prefix_cache and n_bands == 1
+                    and not self._swa_ring_pages and not c.sliding_window
+                    and not self._bridge.enabled):
+                from .prefix_cache import RadixPrefixCache
+                self._prefix_cache = RadixPrefixCache(
+                    self.allocator, block_tokens=self.kv_ppb * page)
             psh = paged_cache_sharding(
                 self.mesh, c.n_kv_heads,
                 n_layers=c.n_layers if self.pipe_n > 1 else None,
@@ -1277,8 +1310,40 @@ class InferenceEngine:
                 continue
             if self.paged:
                 total = min(len(req.prompt_ids) + req.max_tokens, self.S)
-                if not self.allocator.can_admit(
-                        total, ring_pages=self._swa_ring_pages):
+                # Radix prefix lookup (ISSUE 6): resident prompt blocks map
+                # into the new slot's table row instead of allocating +
+                # prefilling. Penalty requests bypass the cache — their
+                # token-occurrence counts are rebuilt by prefill, which a
+                # skipped span would leave incomplete. Matched nodes are
+                # pinned here; the pins drop at slot release, or right
+                # below if the request parks instead of admitting.
+                matched, shared_pages, nodes = 0, [], []
+                cache = self._prefix_cache
+                if (cache is not None and req.presence_penalty == 0
+                        and req.frequency_penalty == 0):
+                    t_lk = time.monotonic()
+                    matched, shared_pages, nodes = cache.match(
+                        req.prompt_ids)
+                    req.prefix_lookup_ms = 1000.0 * (time.monotonic()
+                                                     - t_lk)
+                ok = self.allocator.can_admit(
+                    total, ring_pages=self._swa_ring_pages,
+                    shared_pages=len(shared_pages))
+                if not ok and cache is not None:
+                    # Page pressure: reclaim cold cache entries (LRU
+                    # leaves; pinned blocks are untouchable) before
+                    # parking the head — the admission-side half of the
+                    # overload/Retry-After machinery.
+                    short = self.allocator.fresh_shortfall(
+                        total, ring_pages=self._swa_ring_pages,
+                        shared_pages=len(shared_pages))
+                    if short > 0 and cache.evict(short) > 0:
+                        ok = self.allocator.can_admit(
+                            total, ring_pages=self._swa_ring_pages,
+                            shared_pages=len(shared_pages))
+                if not ok:
+                    if cache is not None:
+                        cache.release_nodes(nodes)
                     break
             self._head = None
             req.slot = self._free_slots.pop()
@@ -1299,9 +1364,25 @@ class InferenceEngine:
                 self._spec_ema[req.slot] = np.nan
             if self.paged:
                 self.allocator.allocate(req.slot, total,
-                                        ring_pages=self._swa_ring_pages)
+                                        ring_pages=self._swa_ring_pages,
+                                        shared_pages=shared_pages)
                 self._table_dirty = True
-            req.prefill_pos = 0
+                if self._prefix_cache is not None:
+                    self._prefix_cache.record_lookup(matched)
+                    req.cached_tokens = matched
+                    req.prefix_nodes = nodes
+                if matched and self.spec_k:
+                    # Prompt-lookup history for the skipped span: the
+                    # per-chunk maintenance only covers chunks that
+                    # actually run, and its pos==0 reset never fires on a
+                    # warm admission.
+                    self.hist[req.slot, :] = 0
+                    self.hist[req.slot, :matched] = req.prompt_ids[:matched]
+            # Warm admission starts prefill at the match boundary — the
+            # matched span's prefill FLOPs are skipped outright (the
+            # chunk's attention reads the shared pages through the table,
+            # exactly like a later chunk of a cold prefill).
+            req.prefill_pos = req.cached_tokens
             self._running[req.slot] = req
             self._prefilling[req.slot] = req
 
@@ -2454,8 +2535,34 @@ class InferenceEngine:
             req.out_queue.put_nowait(Delta(text=delta, finish_reason=reason))
         self._release(req)
 
+    def _prefix_release(self, req: GenRequest) -> None:
+        """Insert-on-release + unpin (ISSUE 6): index the slot's completed
+        KV into the radix cache BEFORE the allocator frees the row, then
+        drop the pins taken at admission. Only tokens whose cache writes
+        have provably landed are indexed: a mid-prefill cancellation
+        covers the chunks that ran (`prefill_pos`); a decoding slot
+        covers the prompt plus every generated token that has been the
+        INPUT of a fetched step — the last emitted token's KV write may
+        still be in flight, and with lag-one pipelining positions beyond
+        it may hold a dead burst's writes, but both lie in blocks past
+        the indexed span."""
+        cache = self._prefix_cache
+        try:
+            if req.slot in self._prefilling:
+                n_ok = req.prefill_pos
+            else:
+                n_ok = len(req.prompt_ids) + max(0, len(req.generated) - 1)
+            seq = req.prompt_ids + req.generated
+            cache.insert(seq, min(n_ok, self.S, len(seq)),
+                         self.allocator.table[req.slot])
+        finally:
+            cache.release_nodes(req.prefix_nodes)
+            req.prefix_nodes = []
+
     def _release(self, req: GenRequest) -> None:
         if req.slot in self._running:
+            if self.paged and self._prefix_cache is not None:
+                self._prefix_release(req)
             del self._running[req.slot]
             self._prefilling.pop(req.slot, None)
             self.active[req.slot] = False
@@ -2527,6 +2634,13 @@ class InferenceEngine:
             out["page_size"] = self.allocator.page_size
             if self.kv_ppb > 1:
                 out["pages_per_block"] = self.kv_ppb
+            if self._prefix_cache is not None:
+                # Radix prefix cache (ISSUE 6): hit/miss/cached-token
+                # totals plus residency/pin gauges — the obs collector
+                # bridges these onto the engine_prefix_* /metrics series,
+                # and the bench's shared-prefix rung asserts skipped
+                # prefill from them (not from wall clock).
+                out.update(self._prefix_cache.stats())
         gauge = (self._ema_step_ms_stats
                  if self._ema_step_ms_stats is not None
                  else self._step_ms_estimate())

@@ -9,11 +9,21 @@ engine returns an error tuple and the router falls back — SURVEY.md §5
 "failure detection"). Physical page 0 is the trash page for masked scatter
 writes (ops/paged_attention.py) and is never allocated.
 
+Cross-request sharing (ISSUE 6): pages are REFCOUNTED at group
+granularity (group = one superpage run when packing is on, else one
+page). The radix prefix cache (engine/prefix_cache.py) retains resident
+groups past their slot's release and hands them back to later requests
+as ``shared_pages`` at :meth:`allocate` — a group returns to its free
+list only when the last holder (slots mapping it + the cache pin) lets
+go, so an in-flight request can never lose a page to eviction.
+
 Single-threaded by design: called only from the engine's event-loop thread
 (admission/release), mirroring the reference's single-asyncio-process
 concurrency model (SURVEY.md §5 "race detection").
 """
 from __future__ import annotations
+
+from typing import Iterable
 
 import numpy as np
 
@@ -91,6 +101,11 @@ class PageAllocator:
         # (0 is band 0's trash page, never a real mapping).
         self.table = np.zeros((batch, self.pages_per_slot), np.int32)
         self._held: dict[int, list[int]] = {}
+        # Group refcounts (group id = page // group_pages): how many
+        # holders — slots mapping the group plus the prefix cache's pin —
+        # currently keep it alive. Free groups are absent from the dict.
+        self.group_pages = max(1, self.pages_per_block)
+        self._ref: dict[int, int] = {}
         # Slots running the SLIDING-WINDOW RING (allocate(..., ring_pages)):
         # they hold a fixed set of physical pages whose table mappings
         # rotate forward as the window slides (ensure_mapped) — steady-
@@ -116,25 +131,50 @@ class PageAllocator:
             need = -(-need // b) * b
         return need
 
-    def can_admit(self, total_tokens: int, ring_pages: int = 0) -> bool:
+    def can_admit(self, total_tokens: int, ring_pages: int = 0,
+                  shared_pages: int = 0) -> bool:
+        """``shared_pages``: pages of the request's prefix already resident
+        (prefix-cache hit) — only the tail needs fresh groups."""
         need = self.pages_needed(total_tokens, ring_pages)
+        fresh = need - shared_pages
+        if fresh <= 0:
+            return True
         if self.pages_per_block > 1:
-            return need // self.pages_per_block <= len(self._free_sp)
+            return fresh // self.pages_per_block <= len(self._free_sp)
         if self.n_bands == 1:
-            return need <= len(self._free[0])
+            return fresh <= len(self._free[0])
         return all(
             sum(1 for j in range(need) if self._band_of(j) == b)
             <= len(self._free[b])
             for b in range(self.n_bands))
 
+    def fresh_shortfall(self, total_tokens: int, ring_pages: int = 0,
+                        shared_pages: int = 0) -> int:
+        """How many pages short the free pool is of admitting this request
+        — what the engine asks the prefix cache to evict under pressure.
+        Single-band pools only (where sharing/eviction exist)."""
+        need = self.pages_needed(total_tokens, ring_pages) - shared_pages
+        return max(0, need - self.free_pages)
+
+    def _groups_of(self, pages: Iterable[int]) -> list[int]:
+        """Distinct group ids of ``pages``, first-occurrence order."""
+        return list(dict.fromkeys(p // self.group_pages for p in pages))
+
     def allocate(self, slot: int, total_tokens: int,
-                 ring_pages: int = 0) -> bool:
+                 ring_pages: int = 0,
+                 shared_pages: Iterable[int] = ()) -> bool:
         """Reserve a slot's pages for its lifetime. False if insufficient.
 
         ``ring_pages`` (sliding-window models, single band only): hold at
         most that many pages — the whole-lifetime guarantee still stands
         because :meth:`ensure_mapped` recycles the slot's own dead pages
-        instead of allocating, so the holding never grows."""
+        instead of allocating, so the holding never grows.
+
+        ``shared_pages`` (prefix-cache hit): physical pages of the
+        request's resident prompt prefix, in logical order, whole groups
+        only. They map into the slot's leading table rows with their
+        refcount bumped instead of popping the free lists — the matched
+        span's KV is served without allocation or prefill."""
         if slot in self._held:
             raise ValueError(f"slot {slot} already holds pages")
         if ring_pages and self.n_bands > 1:
@@ -146,23 +186,71 @@ class PageAllocator:
             # SWA-ring builds, so this is a misuse guard.
             raise ValueError("ring reservation is incompatible with "
                              "superpage packing")
+        shared = list(shared_pages)
+        if shared:
+            if ring_pages or self.n_bands > 1:
+                raise ValueError("prefix sharing is single-band, "
+                                 "non-ring only (engine gates the cache)")
+            if len(shared) % self.group_pages:
+                raise ValueError("shared prefix must be whole groups")
         need = self.pages_needed(total_tokens, ring_pages)
-        if not self.can_admit(total_tokens, ring_pages):
+        if len(shared) > need:
+            raise ValueError(f"shared prefix ({len(shared)} pages) exceeds "
+                             f"the reservation ({need})")
+        if not self.can_admit(total_tokens, ring_pages, len(shared)):
             return False
+        fresh_n = need - len(shared)
         if self.pages_per_block > 1:
             ppb = self.pages_per_block
-            sps = [self._free_sp.pop() for _ in range(need // ppb)]
+            sps = [self._free_sp.pop() for _ in range(fresh_n // ppb)]
             # Logical group g → superpage sps[g]: pt[slot, g·ppb + i] =
             # sps[g]·ppb + i, aligned and contiguous per run.
-            pages = [sp * ppb + i for sp in sps for i in range(ppb)]
+            fresh = [sp * ppb + i for sp in sps for i in range(ppb)]
         else:
-            pages = [self._free[self._band_of(j)].pop() for j in range(need)]
+            fresh = [self._free[self._band_of(j)].pop()
+                     for j in range(len(shared), need)]
+        for g in self._groups_of(shared):
+            if g not in self._ref:
+                raise ValueError(f"shared group {g} is not live")
+            self._ref[g] += 1
+        for g in self._groups_of(fresh):
+            self._ref[g] = 1
+        pages = shared + fresh
         self._held[slot] = pages
         self.table[slot, :] = 0
         self.table[slot, :need] = pages
         if ring_pages and need < self.pages_needed(total_tokens):
             self._ring_slots.add(slot)
         return True
+
+    def retain(self, pages: Iterable[int]) -> None:
+        """The prefix cache adopts/pins currently-live groups (insert-on-
+        release runs BEFORE the slot's release, so the pages survive it)."""
+        groups = self._groups_of(pages)
+        for g in groups:
+            if g not in self._ref:
+                raise ValueError(f"cannot retain group {g}: not live")
+        for g in groups:
+            self._ref[g] += 1
+
+    def drop(self, pages: Iterable[int]) -> None:
+        """Release one reference on each group (cache eviction); groups
+        whose count reaches zero return to the free lists."""
+        self._deref(pages)
+
+    def _deref(self, pages: Iterable[int]) -> None:
+        for g in self._groups_of(pages):
+            n = self._ref.get(g, 0) - 1
+            if n > 0:
+                self._ref[g] = n
+                continue
+            if n < 0:
+                raise ValueError(f"group {g} over-freed")
+            del self._ref[g]
+            if self.pages_per_block > 1:
+                self._free_sp.append(g)
+            else:
+                self._free[g // self.band_pages].append(g)
 
     def ensure_mapped(self, slot: int, last_logical: int,
                       dead_before: int) -> bool:
@@ -199,20 +287,16 @@ class PageAllocator:
     def release(self, slot: int) -> None:
         pages = self._held.pop(slot, None)
         if pages:
-            if self.pages_per_block > 1:
-                ppb = self.pages_per_block
-                for sp in dict.fromkeys(p // ppb for p in pages):
-                    self._free_sp.append(sp)
-            else:
-                for j, p in enumerate(pages):
-                    self._free[self._band_of(j)].append(p)
+            self._deref(pages)
         self._ring_slots.discard(slot)
         self.table[slot, :] = 0
 
-    def check_invariants(self) -> None:
-        """Test hook: every non-trash page is either free or held by exactly
-        one slot; table rows agree with holdings; banded pages stay in
-        their position band; packed holdings are aligned whole runs."""
+    def check_invariants(self, pinned: Iterable[int] = ()) -> None:
+        """Test hook: every non-trash group is either free or refcounted by
+        exactly its holders (slots mapping it + the cache pin, passed as
+        the pinned page list); table rows agree with holdings; banded
+        pages stay in their position band; packed holdings are aligned
+        whole runs; no group is lost or double-freed."""
         held = [p for pages in self._held.values() for p in pages]
         if self.pages_per_block > 1:
             ppb = self.pages_per_block
@@ -231,13 +315,22 @@ class PageAllocator:
         else:
             free = [p for f in self._free for p in f]
             trash = {b * self.band_pages for b in range(self.n_bands)}
-        assert len(held) == len(set(held)), "page double-held"
-        assert not (set(held) & set(free)), "page both free and held"
+        # Refcount truth: each live group's count equals its holders.
+        expect: dict[int, int] = {}
+        for pages in self._held.values():
+            for g in self._groups_of(pages):
+                expect[g] = expect.get(g, 0) + 1
+        for g in self._groups_of(pinned):
+            expect[g] = expect.get(g, 0) + 1
+        assert expect == self._ref, \
+            f"refcount drift: expected {expect}, have {self._ref}"
+        free_groups = set(self._groups_of(free))
+        assert not (free_groups & set(self._ref)), "group both free and live"
         assert not (trash & set(held + free)), "trash page leaked"
-        n_reserved = (self.pages_per_block if self.pages_per_block > 1
-                      else self.n_bands)
-        assert len(held) + len(free) == self.num_pages - n_reserved, \
-            "page lost"
+        n_groups = self.num_pages // self.group_pages
+        n_trash_groups = 1 if self.pages_per_block > 1 else self.n_bands
+        assert len(free_groups) + len(self._ref) == n_groups - \
+            n_trash_groups, "group lost"
         for slot, pages in self._held.items():
             row = self.table[slot]
             if slot in self._ring_slots:

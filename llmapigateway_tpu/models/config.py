@@ -80,6 +80,11 @@ PRESETS: dict[str, ModelConfig] = {
     "tiny-test": ModelConfig(
         vocab_size=512, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
         d_ff=128, max_seq_len=256),
+    # Same CI-scale geometry with a 1k context: the shared-prefix bench
+    # rung needs room for a >=512-token common prefix plus tails on CPU.
+    "tiny-test-1k": ModelConfig(
+        vocab_size=512, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        d_ff=128, max_seq_len=1024),
     "tiny-qwen-test": ModelConfig(
         family="qwen2", vocab_size=512, d_model=64, n_layers=2, n_heads=4,
         n_kv_heads=2, d_ff=128, max_seq_len=256, tie_embeddings=True,

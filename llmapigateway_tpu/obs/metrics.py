@@ -416,6 +416,29 @@ class GatewayMetrics:
         self.engine_kv_free_pages_total = r.gauge(
             "gateway_engine_kv_free_pages_total",
             "Free pages in the paged-KV pool.", ("engine",))
+        # Radix prefix cache (ISSUE 6). Monotonic engine-side totals are
+        # bridged as gauges like engine_sheds_total (the engine owns the
+        # counter; scrape-time set() keeps restarts honest).
+        self.engine_prefix_cache_hit_total = r.gauge(
+            "gateway_engine_prefix_cache_hit_total",
+            "Admitted requests whose prompt prefix was served from the "
+            "radix KV cache.", ("engine",))
+        self.engine_prefix_cache_miss_total = r.gauge(
+            "gateway_engine_prefix_cache_miss_total",
+            "Admitted requests with no resident prompt prefix.",
+            ("engine",))
+        self.engine_prefix_cached_tokens_total = r.gauge(
+            "gateway_engine_prefix_cached_tokens_total",
+            "Prompt tokens whose prefill was skipped via the radix KV "
+            "cache.", ("engine",))
+        self.engine_prefix_resident_pages_total = r.gauge(
+            "gateway_engine_prefix_resident_pages_total",
+            "KV pages currently pinned by the radix prefix cache.",
+            ("engine",))
+        self.engine_prefix_pinned_refs_total = r.gauge(
+            "gateway_engine_prefix_pinned_refs_total",
+            "In-flight request references pinning resident prefix blocks "
+            "against eviction.", ("engine",))
         self.engine_kv_occupancy_ratio = r.gauge(
             "gateway_engine_kv_occupancy_ratio",
             "Paged-KV pool occupancy (allocated / allocatable).", ("engine",))

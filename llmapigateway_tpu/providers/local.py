@@ -59,6 +59,14 @@ class LocalProvider(Provider):
         t_admit = req.t_admitted or req.t_submit
         obs_trace.record_span("engine.queued", layer="engine",
                               start=req.t_submit, end=t_admit, parent=parent)
+        if req.prefix_lookup_ms is not None:
+            # Radix prefix lookup (ISSUE 6), ran just before admission
+            # stamped t_admitted; cached_tokens is the prefill span the
+            # hit skipped (0 = miss).
+            obs_trace.record_span(
+                "engine.prefix_lookup", layer="engine",
+                start=t_admit - req.prefix_lookup_ms / 1000.0, end=t_admit,
+                parent=parent, cached_tokens=req.cached_tokens)
         obs_trace.record_span("engine.prefill", layer="engine",
                               start=t_admit, end=req.t_first_token,
                               parent=parent,
@@ -129,6 +137,12 @@ class LocalProvider(Provider):
         usage = {"prompt_tokens": len(req.prompt_ids),
                  "completion_tokens": n_gen,
                  "total_tokens": len(req.prompt_ids) + n_gen}
+        if req.cached_tokens:
+            # OpenAI-compatible prefix-cache accounting: the span of the
+            # prompt served from resident KV (prefill skipped). Flows into
+            # the usage DB / stats UI via extract_usage_fields.
+            usage["prompt_tokens_details"] = {
+                "cached_tokens": req.cached_tokens}
         if req.t_first_token is not None:
             usage["ttft_ms"] = round(
                 (req.t_first_token - req.t_submit) * 1000.0, 2)

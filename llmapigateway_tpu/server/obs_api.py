@@ -32,6 +32,11 @@ _ENGINE_GAUGES = (
     ("shed_total", "engine_sheds_total", 1.0),
     ("burst_busy_clamps", "engine_burst_clamps_total", 1.0),
     ("free_pages", "engine_kv_free_pages_total", 1.0),
+    ("prefix_hits_total", "engine_prefix_cache_hit_total", 1.0),
+    ("prefix_misses_total", "engine_prefix_cache_miss_total", 1.0),
+    ("prefix_cached_tokens_total", "engine_prefix_cached_tokens_total", 1.0),
+    ("prefix_resident_pages", "engine_prefix_resident_pages_total", 1.0),
+    ("prefix_pinned_refs", "engine_prefix_pinned_refs_total", 1.0),
     ("hbm_bytes_per_step", "engine_step_hbm_bytes", 1.0),
     ("roofline_fraction", "engine_roofline_ratio", 1.0),
     ("queue_wait_ms_ema", "engine_queue_wait_seconds", 1e-3),

@@ -24,7 +24,11 @@ def test_bench_emits_contract_json_line():
          "--eight-b-preset", "tiny-test", "--eight-b-batch", "2",
          "--eight-b-seq", "128", "--eight-b-steps", "4",
          "--burst-sweep", "0", "--spec-mixed-tokens", "16",
-         "--crossover-seq", "256",
+         # 2x the 256-token default page: the crossover's paged leg must
+         # admit at finer granularity than dense max_seq reservations.
+         "--crossover-seq", "512",
+         "--shared-prefix-len", "64", "--shared-prefix-tail", "16",
+         "--shared-prefix-warm", "2",
          "--swa-preset", "tiny-mistral-test", "--swa-seq", "128",
          "--swa-prompt", "32", "--swa-batch", "2", "--swa-steps", "4"],
         capture_output=True, text=True, timeout=900, env=env, cwd=REPO)
@@ -43,8 +47,14 @@ def test_bench_emits_contract_json_line():
                   "batch_scale", "speculative", "quant_int8",
                   "quant_int8_kv8", "long_ctx", "headline_8b",
                   "paged_sweep", "north_star", "spec_mixed",
-                  "capacity_crossover", "swa", "quant_int4_kv8"):
+                  "capacity_crossover", "swa", "quant_int4_kv8",
+                  "shared_prefix"):
         assert field in extra, (field, sorted(extra))
+    # The radix-cache rung proved reuse structurally: warm requests hit,
+    # tokens were served from cache, and fewer prefill chunks dispatched.
+    sp = extra["shared_prefix"]
+    assert sp["prefix_cached_tokens_total"] > 0, sp
+    assert sp["warm_prefill_calls_max"] < sp["cold_prefill_calls"], sp
     # The paged sweep measured both page sizes and named a winner.
     assert set(extra["paged_sweep"]) >= {"128", "256", "best_page_size"}
     # Equal-HBM crossover ran both legs with paged admitting more slots.

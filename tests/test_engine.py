@@ -13,7 +13,8 @@ import jax
 
 @pytest.fixture(scope="module")
 def engine(stop_engine):
-    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=4,
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=4,
                             max_seq_len=128, prefill_chunk=32,
                             dtype="float32")
     eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
@@ -77,7 +78,8 @@ def test_prefill_group_matches_single_calls():
     import numpy as np
 
     def build():
-        cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=4,
+        cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=4,
                                 max_seq_len=128, prefill_chunk=16,
                                 dtype="float32", decode_burst=4)
         return InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
@@ -115,7 +117,8 @@ async def test_batched_admission_matches_sequential():
     produce the exact greedy tokens of one-at-a-time admission."""
     prompts = [f"batched admission parity {i} " * 2 for i in range(4)]
 
-    cfg1 = LocalEngineConfig(preset="tiny-test", max_batch_size=4,
+    cfg1 = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=4,
                              max_seq_len=128, prefill_chunk=16,
                              dtype="float32", decode_burst=4,
                              prefill_batch=1)
@@ -141,7 +144,8 @@ async def test_cancel_one_of_grouped_admissions():
     """Cancelling one request while its neighbors prefill in the same
     batched-admission group must not disturb the survivors (tokens
     intact) and must free the cancelled slot for reuse."""
-    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=4,
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=4,
                             max_seq_len=128, prefill_chunk=8,
                             dtype="float32", decode_burst=4,
                             prefill_batch=4)
@@ -174,7 +178,8 @@ async def test_pipelined_bursts_match_sync_engine():
     greedy tokens of a fully synchronous engine (decode_burst=1), across
     budgets that land on, before, and after a burst boundary."""
     async def run(burst, max_tokens):
-        cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+        cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=2,
                                 max_seq_len=128, prefill_chunk=32,
                                 dtype="float32", decode_burst=burst)
         eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
@@ -212,7 +217,8 @@ async def test_tp_serving_engages_sharded_pallas_kernels(caplog, kv_quant):
         caplog.clear()
         with caplog.at_level(logging.INFO,
                              logger="llmapigateway_tpu.engine.engine"):
-            cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+            cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=2,
                                     max_seq_len=128, prefill_chunk=32,
                                     dtype="float32", decode_burst=2,
                                     attention=attention, mesh=mesh_cfg,
@@ -236,7 +242,8 @@ async def test_pipelined_slot_reuse_no_token_bleed():
     """A slot released and re-admitted while a burst is in flight must not
     leak the dead request's tokens into the new one (epoch guard in
     _flush_entry). Staggered max_tokens force mid-flight releases."""
-    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=2,
                             max_seq_len=128, prefill_chunk=32,
                             dtype="float32", decode_burst=4)
     eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
@@ -266,7 +273,8 @@ async def test_engine_serves_qwen2_family():
                       n_heads=4, n_kv_heads=2, d_ff=128, max_seq_len=128,
                       tie_embeddings=True, attn_bias=True)
     eng = InferenceEngine(
-        LocalEngineConfig(max_batch_size=2, max_seq_len=64, prefill_chunk=16,
+        LocalEngineConfig(kv_layout="contiguous",
+        max_batch_size=2, max_seq_len=64, prefill_chunk=16,
                           dtype="float32"),
         model_cfg=cfg, devices=[jax.devices("cpu")[0]])
     try:
@@ -310,10 +318,12 @@ async def test_prefill_near_cache_boundary_no_overrun():
     corrupt earlier KV entries. Greedy decode after a boundary-straddling
     prompt must match the same prompt run through a roomy engine."""
     import numpy as np
-    cfg_tight = LocalEngineConfig(preset="tiny-test", max_batch_size=1,
+    cfg_tight = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=1,
                                   max_seq_len=100, prefill_chunk=32,
                                   dtype="float32")
-    cfg_roomy = LocalEngineConfig(preset="tiny-test", max_batch_size=1,
+    cfg_roomy = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=1,
                                   max_seq_len=256, prefill_chunk=32,
                                   dtype="float32")
     dev = [jax.devices("cpu")[0]]
@@ -342,7 +352,8 @@ async def test_prefill_near_cache_boundary_no_overrun():
 async def test_stop_flushes_waiting_consumers():
     """stop() must emit terminal deltas for queued requests so no consumer
     hangs (review finding)."""
-    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=1,
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=1,
                             max_seq_len=64, prefill_chunk=16, dtype="float32")
     eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
     req = GenRequest(prompt_ids=[1, 2, 3], max_tokens=4)
@@ -362,7 +373,8 @@ async def test_ttft_under_load_first_token_within_bounded_steps():
     pending), not after the running request drains."""
     from llmapigateway_tpu.engine.engine import FaultPlan
 
-    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=2,
                             max_seq_len=128, prefill_chunk=16,
                             dtype="float32", decode_burst=8)
     eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
@@ -399,7 +411,8 @@ def test_ttft_target_caps_idle_burst_depth():
     to a compiled scan depth; busy depth and the no-model warmup are
     unaffected. (VERDICT r4 item 2: TTFT exposure is the in-flight
     burst — a fixed deep depth is only right for one step time.)"""
-    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=2,
                             max_seq_len=64, prefill_chunk=16,
                             dtype="float32", decode_burst=32,
                             decode_burst_busy=4, ttft_target_ms=100.0)
@@ -435,7 +448,8 @@ def test_step_time_fit_removes_per_burst_fixed_cost():
     372 tok/s through the scheduler vs 1468 at a fixed burst 16, same
     TTFT target). The fit makes the loop self-correcting: shallow-depth
     samples plus ANY second depth recover the true step time."""
-    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=2,
                             max_seq_len=64, prefill_chunk=16,
                             dtype="float32", decode_burst=32,
                             decode_burst_busy=4, ttft_target_ms=100.0)
@@ -466,7 +480,8 @@ def test_step_time_fit_ignores_stale_depths():
     use it (stale w[32] from short-context warmup would UNDERestimate
     the step time after contexts grow — deepening bursts past the ttft
     budget)."""
-    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=2,
                             max_seq_len=64, prefill_chunk=16,
                             dtype="float32", decode_burst=32,
                             decode_burst_busy=4, ttft_target_ms=100.0)
@@ -491,7 +506,8 @@ def test_fitted_slope_survives_depth_aging_out():
     shrinking the cap further, permanently. The fitted slope must
     PERSIST (TTL'd) across the aging-out, holding the cap at the fitted
     operating point."""
-    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=2,
                             max_seq_len=64, prefill_chunk=16,
                             dtype="float32", decode_burst=32,
                             decode_burst_busy=4, ttft_target_ms=200.0)
@@ -526,7 +542,8 @@ def test_explore_bursts_keep_second_depth_fresh():
     always has a second fresh depth (without it, exploration never
     happens once the cap settles, and the fit starves — the other half
     of the spiral fix)."""
-    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=2,
                             max_seq_len=64, prefill_chunk=16,
                             dtype="float32", decode_burst=32,
                             decode_burst_busy=4, ttft_target_ms=200.0)
@@ -567,7 +584,8 @@ def test_burst_walls_sample_any_steady_depth():
     (busy stretches at the shallow depth included — the model must not
     go stale under sustained load), and a depth transition never
     samples (its wall mixes two depths)."""
-    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=2,
                             max_seq_len=96, prefill_chunk=16,
                             dtype="float32", decode_burst=8,
                             decode_burst_busy=2, ttft_target_ms=100.0)
@@ -593,7 +611,8 @@ def test_burst_walls_sample_any_steady_depth():
 
 
 def test_no_ttft_target_keeps_fixed_depths():
-    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=2,
                             max_seq_len=64, prefill_chunk=16,
                             dtype="float32", decode_burst=8,
                             decode_burst_busy=2)
@@ -613,7 +632,8 @@ def test_prefill_aware_clamp_caps_busy_depth():
     (to the synchronous burst=1 path if nothing compiled fits) and
     leaves idle-queue depth untouched — fixed-burst TTFT without the
     fixed-burst throughput tax."""
-    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=2,
                             max_seq_len=64, prefill_chunk=16,
                             dtype="float32", decode_burst=32,
                             decode_burst_busy=16, ttft_target_ms=100.0)
@@ -657,7 +677,8 @@ async def test_queue_wait_and_clamp_surface_in_stats_under_load():
     end-to-end."""
     from llmapigateway_tpu.engine.engine import FaultPlan
 
-    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=2,
                             max_seq_len=128, prefill_chunk=16,
                             dtype="float32", decode_burst=8,
                             decode_burst_busy=8, ttft_target_ms=100.0)

@@ -12,7 +12,8 @@ from llmapigateway_tpu.engine.engine import FaultPlan, GenRequest, InferenceEngi
 
 @pytest.fixture(scope="module")
 def engine(stop_engine):
-    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=2,
                             max_seq_len=64, prefill_chunk=8, decode_burst=2)
     eng = InferenceEngine(cfg)
     yield eng

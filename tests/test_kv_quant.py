@@ -219,7 +219,8 @@ async def test_engine_pallas_with_kv_quant_matches_reference():
     from tests.conftest import cpu_devices
 
     async def run(attention):
-        cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=1,
+        cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=1,
                                 max_seq_len=64, prefill_chunk=16,
                                 decode_burst=2, kv_quant="int8",
                                 attention=attention,
@@ -251,7 +252,8 @@ async def test_seq_sharded_engine_with_kv_quant():
     from tests.conftest import cpu_devices
 
     async def run(mesh, devs):
-        cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+        cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=2,
                                 max_seq_len=128, prefill_chunk=32,
                                 dtype="float32", decode_burst=2,
                                 kv_quant="int8", mesh=mesh,
@@ -284,7 +286,8 @@ async def test_pipelined_engine_with_kv_quant():
     from tests.conftest import cpu_devices
 
     async def run(mesh, devs):
-        cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+        cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=2,
                                 max_seq_len=128, prefill_chunk=32,
                                 dtype="float32", decode_burst=2,
                                 kv_quant="int8", mesh=mesh,
@@ -395,9 +398,11 @@ def test_kv_quant_guardrails():
     base = dict(preset="tiny-test", max_batch_size=1, max_seq_len=64,
                 compilation_cache_dir="off")
     with pytest.raises(ValueError, match="kv_quant"):
-        InferenceEngine(LocalEngineConfig(kv_quant="int4", **base))
+        InferenceEngine(LocalEngineConfig(kv_layout="contiguous",
+        kv_quant="int4", **base))
     # Speculation's exact-greedy guarantee can't hold against a quantized
     # cache (the verify self-block sees drafts at full precision).
     with pytest.raises(ValueError, match="speculative"):
-        InferenceEngine(LocalEngineConfig(kv_quant="int8", spec_draft_len=3,
+        InferenceEngine(LocalEngineConfig(kv_layout="contiguous",
+        kv_quant="int8", spec_draft_len=3,
                                           **base))

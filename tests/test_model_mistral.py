@@ -104,6 +104,7 @@ async def _serve(mesh, devs, max_tokens=16, **kw):
     # depths = different programs = near-tie argmax flips on random
     # weights; see test_speculative._engine).
     kw.setdefault("decode_burst_busy", 4)
+    kw.setdefault("kv_layout", "contiguous")   # dense reference by default
     cfg = LocalEngineConfig(preset="tiny-mistral-test", max_batch_size=2,
                             max_seq_len=128, prefill_chunk=32,
                             dtype="float32", decode_burst=4, mesh=mesh,
@@ -168,7 +169,8 @@ async def test_engine_swa_paged_pallas_matches_reference():
 
 def test_swa_guardrails():
     with pytest.raises(ValueError, match="seq"):
-        InferenceEngine(LocalEngineConfig(
+        InferenceEngine(LocalEngineConfig(kv_layout="contiguous",
+        
             preset="tiny-mistral-test", max_batch_size=1, max_seq_len=64,
             mesh={"seq": 4}, compilation_cache_dir="off"),
             devices=cpu_devices()[:4])

@@ -131,7 +131,8 @@ async def test_engine_serves_moe_preset():
     from llmapigateway_tpu.config.schemas import LocalEngineConfig
     from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
 
-    eng = InferenceEngine(LocalEngineConfig(
+    eng = InferenceEngine(LocalEngineConfig(kv_layout="contiguous",
+        
         preset="tiny-moe-test", dtype="float32", max_batch_size=2,
         max_seq_len=64, prefill_chunk=16))
     try:

@@ -91,7 +91,8 @@ async def test_engine_start_terminal_after_multihost_shutdown():
     import jax
 
     eng = InferenceEngine(
-        LocalEngineConfig(preset="tiny-test", max_batch_size=1,
+        LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=1,
                           max_seq_len=64, prefill_chunk=16, dtype="float32"),
         devices=[jax.devices("cpu")[0]])
     eng._bridge.enabled = True

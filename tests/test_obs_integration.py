@@ -69,6 +69,10 @@ class ObsGateway:
                      "engine": {"preset": "tiny-test", "dtype": "float32",
                                 "max_batch_size": 2, "max_seq_len": 128,
                                 "prefill_chunk": 32, "decode_burst": 4,
+                                # Paged + radix prefix cache ride the 0.19
+                                # DEFAULTS here; the small page makes chat
+                                # prompts span shareable blocks.
+                                "kv_page_size": 16,
                                 "max_tokens_default": 8}}},
         ]
         rules = [
@@ -298,6 +302,72 @@ async def test_metrics_endpoint_is_unauthenticated_and_unlogged(
     assert not any("GET /metrics" in r.getMessage() for r in caplog.records)
     assert not any(getattr(r, "path", "") == "/metrics"
                    for r in caplog.records)
+
+
+# -- prefix cache: /metrics series + SSE usage frame + trace span ------------
+
+async def test_prefix_cache_metrics_usage_frame_and_trace(tmp_path,
+                                                          local_factory):
+    """ISSUE 6 observability: the engine_prefix_* series appear in the
+    exposition with the validator's grammar, a warm request's SSE usage
+    frame reports OpenAI-compatible ``prompt_tokens_details.cached_tokens``
+    (which the usage DB ingests), and its trace tree carries the
+    ``engine.prefix_lookup`` span."""
+    async with ObsGateway(tmp_path, local_factory) as g:
+        body = {"model": "gw/local-direct", "stream": True, "max_tokens": 3,
+                "messages": [{"role": "user",
+                              "content": "please summarize the quarterly "
+                                         "llama serving report briefly"}]}
+        resp = await g.client.post("/v1/chat/completions", json=body)
+        assert resp.status == 200
+        await read_sse_frames(resp)
+        resp = await g.client.post("/v1/chat/completions", json=body,
+                                   headers={"x-request-id": "warm-hit-1"})
+        assert resp.status == 200
+        frames = await read_sse_frames(resp)
+        usage_frames = [json.loads(f) for f in frames
+                        if f != "[DONE]" and "usage" in f]
+        usage = usage_frames[-1]["usage"]
+        cached = usage.get("prompt_tokens_details", {}).get("cached_tokens")
+        assert cached and cached > 0
+        assert cached <= usage["prompt_tokens"]
+
+        # The usage ledger ingested the cached-token detail.
+        from llmapigateway_tpu.server.usage_capture import \
+            extract_usage_fields
+        assert extract_usage_fields(usage)["cached_tokens"] == cached
+
+        # Trace: the lookup span sits among the engine phases with the
+        # hit span recorded as an attribute.
+        resp = await g.client.get("/v1/api/trace/warm-hit-1")
+        doc = await resp.json()
+        assert doc["complete"] is True
+        assert_all_closed(doc)
+        lookups = [s for s in walk_spans(doc["spans"])
+                   if s["name"] == "engine.prefix_lookup"]
+        assert lookups and lookups[0]["attrs"]["cached_tokens"] == cached
+
+        # /metrics: hit/miss totals, cached tokens, residency + pin
+        # gauges, all under the exposition grammar.
+        resp = await g.client.get("/metrics")
+        text = await resp.text()
+    families = validate_prometheus_text(text)
+
+    def val(fam, **labels):
+        for name, got, value in families[fam]["samples"]:
+            if all(got.get(k) == v for k, v in labels.items()):
+                return value
+        return None
+
+    assert val("gateway_engine_prefix_cache_hit_total", engine="tpu") >= 1
+    assert val("gateway_engine_prefix_cache_miss_total",
+               engine="tpu") is not None
+    assert val("gateway_engine_prefix_cached_tokens_total",
+               engine="tpu") >= cached
+    assert val("gateway_engine_prefix_resident_pages_total",
+               engine="tpu") >= 1
+    assert val("gateway_engine_prefix_pinned_refs_total",
+               engine="tpu") is not None
 
 
 # -- chaos: deadline mid-stream ----------------------------------------------

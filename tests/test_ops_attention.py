@@ -189,7 +189,8 @@ async def test_engine_with_pallas_attention():
     from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
 
     async def run(attention):
-        eng = InferenceEngine(LocalEngineConfig(
+        eng = InferenceEngine(LocalEngineConfig(kv_layout="contiguous",
+        
             preset="tiny-test", dtype="float32", max_batch_size=2,
             max_seq_len=64, prefill_chunk=16, attention=attention),
             devices=[jax.devices("cpu")[0]])
@@ -268,7 +269,8 @@ async def test_engine_tp_mesh_pallas_attention_parity():
     from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
 
     async def run(attention, mesh, n_dev):
-        eng = InferenceEngine(LocalEngineConfig(
+        eng = InferenceEngine(LocalEngineConfig(kv_layout="contiguous",
+        
             preset="tiny-test", dtype="float32", max_batch_size=2,
             max_seq_len=64, prefill_chunk=16, attention=attention,
             mesh=mesh),

@@ -130,7 +130,8 @@ async def test_engine_serves_with_pipeline_stages():
     prompt = list((np.arange(50) * 11 + 2) % 500)
 
     async def run(mesh, devices):
-        cfg = LocalEngineConfig(
+        cfg = LocalEngineConfig(kv_layout="contiguous",
+        
             preset="tiny-test", max_batch_size=2, max_seq_len=128,
             prefill_chunk=32, dtype="float32", mesh=mesh,
             attention="reference")
@@ -248,7 +249,8 @@ async def test_engine_serves_moe_with_pipeline_and_expert_axes():
     prompt = list((np.arange(40) * 7 + 2) % 500)
 
     async def run(mesh, devices):
-        cfg = LocalEngineConfig(
+        cfg = LocalEngineConfig(kv_layout="contiguous",
+        
             preset="tiny-moe-test", max_batch_size=2, max_seq_len=128,
             prefill_chunk=32, dtype="float32", mesh=mesh,
             attention="reference", prewarm_sampler_variants=False,

@@ -160,7 +160,8 @@ def test_engine_e2e_with_quant(preset):
     tied-embedding int8 head copy)."""
     from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
 
-    cfg = LocalEngineConfig(preset=preset, max_batch_size=2,
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset=preset, max_batch_size=2,
                             max_seq_len=128, prefill_chunk=16,
                             decode_burst=4, quant="int8",
                             prewarm_sampler_variants=False,
@@ -206,7 +207,8 @@ def test_checkpoint_load_quantizes_on_host(tmp_path):
     transformers.LlamaForCausalLM(hf_cfg).save_pretrained(
         tmp_path, safe_serialization=True)
 
-    cfg = LocalEngineConfig(model_path=str(tmp_path), max_batch_size=1,
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        model_path=str(tmp_path), max_batch_size=1,
                             max_seq_len=64, prefill_chunk=16, decode_burst=2,
                             quant="int8", prewarm_sampler_variants=False,
                             compilation_cache_dir="off")
@@ -264,7 +266,8 @@ def test_checkpoint_tied_head_quantizes_on_device(tmp_path):
     transformers.LlamaForCausalLM(hf_cfg).save_pretrained(
         tmp_path, safe_serialization=True)
 
-    cfg = LocalEngineConfig(model_path=str(tmp_path), max_batch_size=1,
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        model_path=str(tmp_path), max_batch_size=1,
                             max_seq_len=64, prefill_chunk=16, decode_burst=2,
                             quant="int8", prewarm_sampler_variants=False,
                             compilation_cache_dir="off")
@@ -352,7 +355,8 @@ async def test_seq_sharded_engine_with_quant_matches_single_device():
     from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
 
     async def run(mesh, devs):
-        cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+        cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=2,
                                 max_seq_len=128, prefill_chunk=32,
                                 dtype="float32", decode_burst=2,
                                 quant="int8", mesh=mesh,
@@ -377,7 +381,8 @@ async def test_seq_sharded_engine_with_quant_matches_single_device():
 def test_moe_engine_e2e_with_quant():
     from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
 
-    cfg = LocalEngineConfig(preset="tiny-moe-test", quant="int8",
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-moe-test", quant="int8",
                             max_batch_size=2, max_seq_len=128,
                             prefill_chunk=16, decode_burst=4,
                             prewarm_sampler_variants=False,
@@ -402,7 +407,8 @@ def test_moe_engine_e2e_with_quant():
 def test_quant_rejects_unknown_mode():
     from llmapigateway_tpu.engine.engine import InferenceEngine
 
-    cfg = LocalEngineConfig(preset="tiny-test", quant="int2",
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", quant="int2",
                             max_batch_size=1, max_seq_len=64,
                             compilation_cache_dir="off")
     with pytest.raises(ValueError, match="quant"):
@@ -459,7 +465,8 @@ def test_engine_e2e_with_int4(preset):
     checks the tied-head copy stays int8)."""
     from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
 
-    cfg = LocalEngineConfig(preset=preset, max_batch_size=2,
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset=preset, max_batch_size=2,
                             max_seq_len=128, prefill_chunk=16,
                             decode_burst=4, quant="int4",
                             prewarm_sampler_variants=False,
@@ -523,7 +530,8 @@ def test_int4_checkpoint_load_quantizes_on_host(tmp_path):
         "num_key_value_heads": cfg.n_kv_heads,
         "intermediate_size": cfg.d_ff}))
 
-    eng = InferenceEngine(LocalEngineConfig(
+    eng = InferenceEngine(LocalEngineConfig(kv_layout="contiguous",
+        
         model_path=str(tmp_path), max_batch_size=1, max_seq_len=64,
         prefill_chunk=16, quant="int4", prewarm_sampler_variants=False,
         compilation_cache_dir="off"))
@@ -536,7 +544,8 @@ def test_moe_engine_e2e_with_int4():
     int4 with per-(expert, out-channel) scales and still serve."""
     from llmapigateway_tpu.engine.engine import GenRequest, InferenceEngine
 
-    cfg = LocalEngineConfig(preset="tiny-moe-test", max_batch_size=2,
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-moe-test", max_batch_size=2,
                             max_seq_len=128, prefill_chunk=16,
                             decode_burst=4, quant="int4",
                             prewarm_sampler_variants=False,

@@ -107,7 +107,7 @@ async def test_engine_serves_seq_sharded_prompt():
 
     async def run(mesh, devices):
         cfg = LocalEngineConfig(
-            preset="tiny-test", max_batch_size=2, max_seq_len=128,
+            kv_layout="contiguous", preset="tiny-test", max_batch_size=2, max_seq_len=128,
             prefill_chunk=32, dtype="float32", mesh=mesh,
             attention="reference")
         eng = InferenceEngine(cfg, devices=devices)
@@ -144,7 +144,7 @@ async def test_engine_serves_ulysses_seq_mode():
 
     async def run(mesh, devices, **kw):
         cfg = LocalEngineConfig(
-            preset="tiny-test", max_batch_size=2, max_seq_len=128,
+            kv_layout="contiguous", preset="tiny-test", max_batch_size=2, max_seq_len=128,
             prefill_chunk=32, dtype="float32", mesh=mesh,
             attention="reference", **kw)
         eng = InferenceEngine(cfg, devices=devices)
@@ -174,7 +174,8 @@ async def test_engine_ulysses_falls_back_when_heads_dont_divide():
     from llmapigateway_tpu.config.schemas import LocalEngineConfig
     from llmapigateway_tpu.engine.engine import InferenceEngine
 
-    eng = InferenceEngine(LocalEngineConfig(
+    eng = InferenceEngine(LocalEngineConfig(kv_layout="contiguous",
+        
         preset="tiny-test", max_batch_size=2, max_seq_len=128,
         prefill_chunk=32, dtype="float32", mesh={"seq": 4},
         attention="reference", seq_attention="ulysses"),

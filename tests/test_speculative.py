@@ -23,6 +23,7 @@ def _engine(spec=0, **kw):
     # comparisons timing-flaky (1-core repro: two stable greedy
     # continuations of the same prompt).
     kw.setdefault("decode_burst_busy", 8)
+    kw.setdefault("kv_layout", "contiguous")
     cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
                             max_seq_len=192, prefill_chunk=32,
                             dtype="float32", decode_burst=8,
@@ -372,7 +373,8 @@ async def test_spec_composes_with_seq_and_pipe_sharding(mesh, n_dev):
                                 spec_draft_len=spec, mesh=m,
                                 attention="reference",
                                 prewarm_sampler_variants=False,
-                                compilation_cache_dir="off")
+                                compilation_cache_dir="off",
+                                kv_layout="contiguous")
         eng = InferenceEngine(cfg, devices=devs)
         req = await _gen(eng, prompt, max_tokens=24)
         await eng.stop()

@@ -12,7 +12,8 @@ from llmapigateway_tpu.engine.tokenizer import ByteTokenizer, IncrementalDetoken
 
 @pytest.fixture(scope="module")
 def engine(stop_engine):
-    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+    cfg = LocalEngineConfig(kv_layout="contiguous",
+        preset="tiny-test", max_batch_size=2,
                             max_seq_len=128, prefill_chunk=32,
                             dtype="float32", decode_burst=4)
     eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
