@@ -637,4 +637,10 @@ def default_instrumented_classes() -> list[type]:
     # thread (ISSUE 6).
     from ..engine.prefix_cache import RadixPrefixCache
     classes.append(RadixPrefixCache)
+    # The flight recorder's ring is single-writer-from-the-loop BY
+    # CONTRACT (ISSUE 7: allocation- AND lock-free appends); the
+    # sanitizer enforcing its `guarded-by: loop` fields is what makes
+    # that contract testable instead of aspirational.
+    from ..obs.flight import FlightRecorder
+    classes.append(FlightRecorder)
     return classes

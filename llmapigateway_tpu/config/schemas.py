@@ -173,6 +173,14 @@ class LocalEngineConfig(BaseModel):
     # Numerics sanitizer (SURVEY.md §5 "race detection / sanitizers"): raise
     # on NaN production inside compiled programs (costs performance; debug).
     debug_nans: bool = False
+    # Scheduler flight recorder (ISSUE 7): capacity of the preallocated
+    # per-step/lifecycle record ring (obs/flight.py), served at
+    # GET /v1/api/flight and exported by tools/flight_report.py. Appends
+    # are allocation- and lock-free on the step path, so the recorder is
+    # on by default; ring-wrap loss is visible as the
+    # gateway_engine_flight_ring_evicted_total series. 0 disables.
+    # (Same knob pattern as the gateway-level TRACE_RING_SIZE.)
+    flight_ring_size: int = 4096
 
 
 class BreakerSettings(BaseModel):
@@ -263,6 +271,16 @@ class ModelFallbackConfig(BaseModel):
     # gateway-wide DEFAULT_REQUEST_TIMEOUT_MS (which itself defaults to
     # unbounded). Exhaustion returns HTTP 504 with per-attempt detail.
     timeout_ms: float = Field(default=0.0, ge=0)
+    # Default per-request SLO targets (ms) for this gateway model when
+    # the client sends no `x-slo-ttft-ms` / `x-slo-tpot-ms` headers
+    # (obs/slo.py; ISSUE 7). Unlike timeout_ms these never fail a
+    # request — they only classify it: outcomes land on the
+    # gateway_slo_{met,violated}_total /metrics series, the usage DB
+    # row, and the final usage frame, with TTFT violations attributed
+    # (queued / prefill / decode_contention) from the flight recorder.
+    # 0 = no target.
+    slo_ttft_ms: float = Field(default=0.0, ge=0)
+    slo_tpot_ms: float = Field(default=0.0, ge=0)
 
     @field_validator("rotate_models", mode="before")
     @classmethod

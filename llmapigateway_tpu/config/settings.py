@@ -63,6 +63,10 @@ class Settings:
     # precedence; 0 = unbounded (each attempt still bounded by the
     # transport's 300 s cap).
     default_request_timeout_ms: float = 0.0
+    # Capacity of the request-trace ring buffer (obs/trace.py): the most
+    # recent N requests stay queryable at /v1/api/trace/{id}. Loss under
+    # load is visible as gateway_trace_ring_evicted_total (ISSUE 7).
+    trace_ring_size: int = 256
     # Directories (relative to base_dir unless absolute)
     base_dir: Path = field(default_factory=Path.cwd)
     config_dir: Path | None = None
@@ -96,6 +100,7 @@ class Settings:
             debug_mode=_as_bool(merged.get("DEBUG_MODE"), False),
             default_request_timeout_ms=float(
                 merged.get("DEFAULT_REQUEST_TIMEOUT_MS", "0") or 0),
+            trace_ring_size=int(merged.get("TRACE_RING_SIZE", "256") or 256),
             base_dir=base,
             config_dir=_path("CONFIG_DIR", "."),
             db_dir=_path("DB_DIR", "db"),

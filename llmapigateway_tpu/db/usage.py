@@ -41,6 +41,11 @@ class UsageRecord:
     cost: float = 0.0
     ttft_ms: float | None = None
     tokens_per_sec: float | None = None
+    # SLO attribution (ISSUE 7): 1/0 when the request carried targets
+    # (None = no SLO), and the violated phase (queued / prefill /
+    # decode_contention / decode) when it missed them.
+    slo_met: int | None = None
+    slo_phase: str | None = None
     timestamp: str = field(default_factory=lambda: time.strftime("%Y-%m-%d %H:%M:%S"))
 
 
@@ -69,8 +74,18 @@ class UsageDB:
                        model TEXT,
                        provider TEXT,
                        ttft_ms REAL,
-                       tokens_per_sec REAL
+                       tokens_per_sec REAL,
+                       slo_met INTEGER,
+                       slo_phase TEXT
                    )""")
+            # Migrate pre-0.20 ledgers in place (ALTER ADD is cheap and
+            # idempotent-by-check; rows predating the SLO plane stay NULL).
+            cols = {r[1] for r in self._conn.execute(
+                "PRAGMA table_info(tokens_usage)")}
+            for col, decl in (("slo_met", "INTEGER"), ("slo_phase", "TEXT")):
+                if col not in cols:
+                    self._conn.execute(
+                        f"ALTER TABLE tokens_usage ADD COLUMN {col} {decl}")
             self._conn.execute(
                 "CREATE INDEX IF NOT EXISTS idx_tokens_usage_ts "
                 "ON tokens_usage(timestamp)")
@@ -86,12 +101,12 @@ class UsageDB:
                     """INSERT INTO tokens_usage
                        (timestamp, prompt_tokens, completion_tokens, total_tokens,
                         reasoning_tokens, cached_tokens, cost, model, provider,
-                        ttft_ms, tokens_per_sec)
-                       VALUES (?,?,?,?,?,?,?,?,?,?,?)""",
+                        ttft_ms, tokens_per_sec, slo_met, slo_phase)
+                       VALUES (?,?,?,?,?,?,?,?,?,?,?,?,?)""",
                     (rec.timestamp, rec.prompt_tokens, rec.completion_tokens,
                      rec.total_tokens, rec.reasoning_tokens, rec.cached_tokens,
                      rec.cost, rec.model, rec.provider, rec.ttft_ms,
-                     rec.tokens_per_sec))
+                     rec.tokens_per_sec, rec.slo_met, rec.slo_phase))
                 self._conn.commit()
         except sqlite3.Error:
             logger.exception("usage insert failed (ignored)")
@@ -131,7 +146,9 @@ class UsageDB:
                            SUM(cost) AS cost,
                            COUNT(*) AS requests,
                            AVG(ttft_ms) AS avg_ttft_ms,
-                           AVG(tokens_per_sec) AS avg_tokens_per_sec
+                           AVG(tokens_per_sec) AS avg_tokens_per_sec,
+                           SUM(slo_met) AS slo_met_requests,
+                           COUNT(slo_met) AS slo_requests
                     FROM tokens_usage
                     WHERE timestamp >= ? AND timestamp <= ?
                     GROUP BY period, model

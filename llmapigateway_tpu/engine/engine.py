@@ -128,6 +128,15 @@ class GenRequest:
     presence_penalty: float = 0.0     # OpenAI semantics; engine-native
     frequency_penalty: float = 0.0    # (engine/sampling.py apply_penalties)
     stop: list[str] = field(default_factory=list)
+    # Gateway request id (providers/local.py sets it from the active
+    # trace) — what the flight recorder's lifecycle records carry, so a
+    # scheduler timeline row links back to /v1/api/trace/{id}.
+    request_id: str = ""
+    # Per-request SLO targets in ms (obs/slo.py; None = no target). The
+    # outcome is computed at stream end from the timestamps below and
+    # attributed against the flight recorder's step records.
+    slo_ttft_ms: float | None = None
+    slo_tpot_ms: float | None = None
 
     # Filled by the engine:
     slot: int = -1
@@ -150,6 +159,10 @@ class GenRequest:
     t_admitted: float | None = None   # slot admission (queued-phase end)
     t_first_token: float | None = None
     t_done: float | None = None
+    # Flight-recorder cross-links (ISSUE 7): the seq numbers of this
+    # request's admit/finish records, surfaced as trace-span attributes.
+    flight_admit_seq: int = -1
+    flight_done_seq: int = -1
 
     @property
     def done(self) -> bool:
@@ -385,7 +398,15 @@ class InferenceEngine:
         self._loop_task: asyncio.Task | None = None
         self._stopped = False
         self._work_event = asyncio.Event()
+        self._loop = None               # the loop _work_event is bound to
         self._warm_thread = None
+        # Scheduler flight recorder (ISSUE 7): per-step and lifecycle
+        # records in a preallocated ring, appended only from the loop
+        # thread (its fields are `guarded-by: loop`; the sanitizer
+        # instruments the class). None = disabled (flight_ring_size 0).
+        from ..obs.flight import FlightRecorder
+        self.flight = (FlightRecorder(engine_cfg.flight_ring_size)
+                       if engine_cfg.flight_ring_size > 0 else None)
 
     # -- initialization ------------------------------------------------------
     def _init_params(self) -> None:
@@ -1168,8 +1189,18 @@ class InferenceEngine:
         if self._loop_task is None:
             self._stopped = False        # restartable after stop()
             self._enable_debug_nans()
-            self._loop_task = asyncio.get_running_loop().create_task(
-                self._run_loop())
+            loop = asyncio.get_running_loop()
+            if self._loop is not loop:
+                # asyncio.Event binds to the first loop that awaits it; a
+                # restarted engine on a NEW loop (sequential asyncio.run
+                # phases — the bench does this between rungs) would die
+                # with a cross-loop RuntimeError at its first idle
+                # `_work_event.wait()`, silently stranding every later
+                # submit. Fresh event per serving loop; submit()/stop()
+                # set it only after start(), so no waiter is orphaned.
+                self._work_event = asyncio.Event()
+                self._loop = loop
+            self._loop_task = loop.create_task(self._run_loop())
         if (self._warm_thread is None and self.cfg.prewarm_sampler_variants
                 and jax.default_backend() == "tpu"):
             # Pre-lower+compile BOTH sampler variants into the persistent
@@ -1220,6 +1251,11 @@ class InferenceEngine:
             self._queue.put_nowait(req)
         except asyncio.QueueFull:
             self._shed_n += 1
+            if self.flight is not None:
+                from ..obs.flight import SHED
+                self.flight.record(SHED, queued=self._queue.qsize(),
+                                   free_slots=len(self._free_slots),
+                                   rid=req.request_id or None)
             raise EngineOverloaded("engine admission queue is full") from None
         await self.start()
         self._work_event.set()
@@ -1293,7 +1329,17 @@ class InferenceEngine:
     async def _step(self) -> bool:
         """One scheduler iteration. Emission always happens here, on the
         event-loop thread (asyncio.Queue is not thread-safe); worker-thread
-        calls only touch device programs and host numpy state."""
+        calls only touch device programs and host numpy state.
+
+        With the flight recorder on, the iteration leaves ONE step record
+        (composition, burst depth, tokens, fitted-vs-measured step time)
+        plus lifecycle records for admissions/evictions it performed —
+        appended loop-side only, after the worker-thread awaits return."""
+        fl = self.flight
+        t_step0 = fl.clock() if fl is not None else 0.0
+        clamps0 = self._busy_clamps
+        n_chunks = 0                  # compiled prefill dispatches this step
+        n_tok = 0                     # tokens emitted downstream this step
         # 1. Admit into free slots (dropping requests whose client is gone).
         #    Paged layout: the FIFO head also needs its full page reservation
         #    (engine/paged.py policy) — if pages are short it waits at the
@@ -1337,7 +1383,12 @@ class InferenceEngine:
                     short = self.allocator.fresh_shortfall(
                         total, ring_pages=self._swa_ring_pages,
                         shared_pages=len(shared_pages))
-                    if short > 0 and cache.evict(short) > 0:
+                    evicted = cache.evict(short) if short > 0 else 0
+                    if evicted > 0:
+                        if fl is not None:
+                            from ..obs.flight import EVICT
+                            fl.record(EVICT, val=float(evicted),
+                                      free_pages=self.allocator.free_pages)
                         ok = self.allocator.can_admit(
                             total, ring_pages=self._swa_ring_pages,
                             shared_pages=len(shared_pages))
@@ -1385,6 +1436,16 @@ class InferenceEngine:
             req.prefill_pos = req.cached_tokens
             self._running[req.slot] = req
             self._prefilling[req.slot] = req
+            if fl is not None:
+                from ..obs.flight import ADMIT
+                req.flight_admit_seq = fl.record(
+                    ADMIT, slot=req.slot, val=wait_ms,
+                    tokens=req.cached_tokens,
+                    queued=self._queue.qsize() + (1 if self._head else 0),
+                    free_slots=len(self._free_slots),
+                    free_pages=(self.allocator.free_pages if self.paged
+                                else -1),
+                    rid=req.request_id or None)
 
         # 2. Advance each pending prefill by ONE chunk (chunked-prefill
         #    interleave: a long prompt never blocks decode for more than one
@@ -1413,8 +1474,10 @@ class InferenceEngine:
                     continue
                 prompt_done = await asyncio.to_thread(
                     self._prefill_one_chunk, req)
+                n_chunks += 1
                 if prompt_done:
                     del self._prefilling[req.slot]
+                    n_tok += 1
                     self._emit_token(req)  # first token, sampled off prefill
         else:
             groups: dict[int, list[GenRequest]] = {}
@@ -1442,9 +1505,11 @@ class InferenceEngine:
                     pending = live[len(batch):]
                     dones = await asyncio.to_thread(
                         self._prefill_chunk_group, batch)
+                    n_chunks += 1
                     for req, prompt_done in zip(batch, dones):
                         if prompt_done:
                             del self._prefilling[req.slot]
+                            n_tok += 1
                             self._emit_token(req)
 
         # 3. A decode burst for all slots in decode phase. Burst depth adapts:
@@ -1574,8 +1639,10 @@ class InferenceEngine:
                     burst = min(burst, max(1, room), -(-left // kp1))
                 if self._swa_ring_pages:
                     self._swa_rotate(decoding, inflight, max(1, burst) * kp1)
+                burst = max(1, burst)
+                t_dec0 = fl.clock() if fl is not None else 0.0
                 step_tokens = await asyncio.to_thread(
-                    self._spec_burst, max(1, burst))
+                    self._spec_burst, burst)
             else:
                 burst = self._burst_depth(busy)
                 # Never burst past any slot's cache capacity or token
@@ -1594,8 +1661,11 @@ class InferenceEngine:
                 burst = max(1, burst)
                 if self._swa_ring_pages:
                     self._swa_rotate(decoding, inflight, burst)
+                t_dec0 = fl.clock() if fl is not None else 0.0
                 step_tokens = await asyncio.to_thread(
                     self._decode_burst, burst)
+            dec_wall_ms = (1000.0 * (fl.clock() - t_dec0)
+                           if fl is not None else 0.0)
             for tokens in step_tokens:          # in generation order
                 for req in decoding:
                     if req.done:
@@ -1606,9 +1676,54 @@ class InferenceEngine:
                         # slot's current request (masked in _flush_entry).
                         continue
                     req.generated.append(tok)
+                    n_tok += 1
                     self._emit_token(req)
-            return True
-        return bool(self._prefilling)
+        progressed = bool(decoding) or bool(self._prefilling)
+        if not progressed and self._free_slots and (
+                self._head is not None or not self._queue.empty()):
+            # Slots freed DURING this step (e.g. every prefilling request
+            # cancelled mid-chunk) while admissions still wait: phase 1
+            # already ran with no free slot, and nothing but submit()
+            # sets the work event — without this the loop parks and
+            # strands the queue until the next request arrives (latent
+            # since the chunked-prefill interleave; the flight recorder's
+            # cancellation chaos test caught it).
+            progressed = True
+        if fl is not None and (n_chunks or decoding):
+            # The step record: what this iteration ran, how long it took,
+            # and the scheduler's fitted step time next to the measured
+            # one — the per-decision feed the EMAs compress away.
+            from ..obs import flight as _fl
+            flag = 0
+            depth = 0
+            if n_chunks:
+                flag |= _fl.F_PREFILL
+            if decoding:
+                flag |= _fl.F_DECODE
+                depth = burst
+                if spec_now:
+                    flag |= _fl.F_SPEC
+                if busy:
+                    flag |= _fl.F_BUSY
+                if self._busy_clamps > clamps0:
+                    flag |= _fl.F_CLAMPED
+            # The steady-pair EMA gauge, not _step_ms_estimate(): the
+            # fit walks every wall sample and would cost more per step
+            # than the record itself.
+            fitted = self._ema_step_ms_stats
+            fl.record(
+                _fl.STEP, flag=flag, depth=depth, tokens=n_tok,
+                chunks=n_chunks,
+                dur_ms=1000.0 * (fl.clock() - t_step0),
+                val=dec_wall_ms if decoding else 0.0,
+                active=len(self._running),
+                free_slots=len(self._free_slots),
+                queued=self._queue.qsize() + (1 if self._head else 0),
+                free_pages=(self.allocator.free_pages if self.paged
+                            else -1),
+                fitted_ms=(fitted if fitted is not None
+                           else float("nan")))
+        return progressed
 
     # -- compute (worker thread; no asyncio objects touched) ------------------
     def _prefill_one_chunk(self, req: GenRequest) -> bool:
@@ -2564,6 +2679,20 @@ class InferenceEngine:
             if self.paged and self._prefix_cache is not None:
                 self._prefix_release(req)
             del self._running[req.slot]
+            if self.flight is not None:
+                # Every admit record gets a matching finish — the chaos
+                # tests assert the pair count balances (a "leaked" flight
+                # record is a request the scheduler lost track of).
+                from ..obs.flight import FINISH, FINISH_REASONS
+                reason = req.finish_reason or "error"
+                code = (FINISH_REASONS.index(reason)
+                        if reason in FINISH_REASONS else 3)
+                req.flight_done_seq = self.flight.record(
+                    FINISH, slot=req.slot, flag=code,
+                    tokens=len(req.generated),
+                    active=len(self._running),
+                    free_slots=len(self._free_slots),
+                    rid=req.request_id or None)
             self._prefilling.pop(req.slot, None)
             self.active[req.slot] = False
             self.lengths[req.slot] = 0
@@ -2689,8 +2818,20 @@ class InferenceEngine:
             out["burst_walls_ms"] = {
                 d: round(ms, 1)
                 for d, ms in sorted(self._burst_walls.items())}
+        if self.flight is not None:
+            # Flight-recorder counters (ISSUE 7): ring position, loss
+            # under load, and lifecycle balance — bridged onto /metrics
+            # by the obs collector like the prefix/shed counters.
+            out.update(self.flight.stats())
         if self.spec_k:
             out["spec_draft_len"] = self.spec_k
+            # Speculative acceptance telemetry (ROADMAP item 3 stub):
+            # drafted-vs-accepted token totals, bridged to the
+            # gateway_engine_spec_* /metrics series. Each spec step
+            # drafts k tokens per active slot and emits accepted+1.
+            out["spec_proposed"] = self._spec_steps_done * self.spec_k
+            out["spec_accepted"] = max(
+                0, self._spec_tokens_out - self._spec_steps_done)
             if self._spec_steps_done:
                 out["spec_tokens_per_step"] = round(
                     self._spec_tokens_out / self._spec_steps_done, 2)

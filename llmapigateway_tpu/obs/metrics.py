@@ -158,6 +158,13 @@ class Metric:
             raise ValueError(f"{self.name} is labeled; call .labels() first")
         return self.labels()
 
+    def children(self) -> list[tuple[tuple[str, ...], Any]]:
+        """Snapshot of (labelvalues, child) pairs — what scrape-time
+        collectors that DERIVE series (e.g. the SLO goodput ratio) read
+        instead of reparsing the exposition."""
+        with self._lock:
+            return list(self._children.items())
+
     # Unlabeled convenience passthroughs.
     def inc(self, amount: float = 1.0) -> None:
         self._default_child().inc(amount)
@@ -442,6 +449,41 @@ class GatewayMetrics:
         self.engine_kv_occupancy_ratio = r.gauge(
             "gateway_engine_kv_occupancy_ratio",
             "Paged-KV pool occupancy (allocated / allocatable).", ("engine",))
+        # Speculative-decoding acceptance telemetry (ROADMAP item 3 stub;
+        # ISSUE 7 satellite): bridged from the engine's spec_proposed /
+        # spec_accepted stats like the prefix-cache totals.
+        self.engine_spec_proposed_total = r.gauge(
+            "gateway_engine_spec_proposed_total",
+            "Draft tokens proposed by speculative decoding.", ("engine",))
+        self.engine_spec_accepted_total = r.gauge(
+            "gateway_engine_spec_accepted_total",
+            "Draft tokens accepted by the verify forward.", ("engine",))
+        self.engine_spec_acceptance_ratio = r.gauge(
+            "gateway_engine_spec_acceptance_ratio",
+            "Accepted over proposed draft tokens (lifetime).", ("engine",))
+        # Flight recorder (ISSUE 7): ring position and wrap loss.
+        self.engine_flight_ring_evicted_total = r.gauge(
+            "gateway_engine_flight_ring_evicted_total",
+            "Flight-recorder records lost to ring wrap.", ("engine",))
+
+        # -- SLO / goodput attribution plane (ISSUE 7; obs/slo.py) ------------
+        self.slo_met_total = r.counter(
+            "gateway_slo_met_total",
+            "Requests that met every SLO target they carried.",
+            ("engine",))
+        self.slo_violated_total = r.counter(
+            "gateway_slo_violated_total",
+            "Requests that violated an SLO target, by attributed phase "
+            "(queued / prefill / decode_contention / decode).",
+            ("engine", "phase"))
+        self.slo_goodput_ratio = r.gauge(
+            "gateway_slo_goodput_ratio",
+            "Fraction of SLO-carrying requests that met their targets "
+            "(the DistServe goodput numerator over its denominator).",
+            ("engine",))
+        self.trace_ring_evicted_total = r.gauge(
+            "gateway_trace_ring_evicted_total",
+            "Request traces pushed out of the trace ring buffer.")
         self.engine_step_hbm_bytes = r.gauge(
             "gateway_engine_step_hbm_bytes",
             "HBM bytes one decode step must stream (weights + live KV).",

@@ -1,0 +1,156 @@
+"""Per-request SLO targets, outcomes, and violation attribution (ISSUE 7).
+
+*DistServe*-style goodput routing (ROADMAP item 2) admits by per-request
+TTFT/TPOT SLO instead of a single queue, and the sustained-load harness
+(item 5) needs a goodput number to assert — both need the gateway to know,
+per request, whether its latency targets were met and *why not* when they
+weren't. This module is that substrate:
+
+* :class:`SLOTargets` — a request's TTFT/TPOT targets, from the
+  ``x-slo-ttft-ms`` / ``x-slo-tpot-ms`` headers (client ask wins) or the
+  gateway model rule's ``slo_ttft_ms`` / ``slo_tpot_ms`` fields
+  (config/schemas.py), mirroring the deadline-budget precedence chain.
+* :func:`evaluate` — the outcome, computed at stream end from the
+  GenRequest timestamps PR 4 already records (submit / admitted /
+  first-token / done), with a TTFT violation *attributed* to the phase
+  that actually spent the budget: ``queued`` (waiting for a slot),
+  ``prefill`` (the prompt's own compute), or ``decode_contention``
+  (decode bursts interleaving with the request's prefill window —
+  measured from the flight recorder's step records, not guessed).
+
+Outcomes feed three sinks: ``gateway_slo_{met,violated}_total`` counters
+plus the goodput gauge on ``/metrics`` (providers/local.py records,
+server/obs_api.py derives), the usage DB row (``slo_met`` /
+``slo_phase`` columns), and the request's final usage frame.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+from .flight import F_DECODE, FlightRecorder
+
+# Attribution phases for a TTFT violation, in the order the budget is
+# spent: slot wait, then prompt compute, with decode bursts possibly
+# stealing the window in between. TPOT violations are always "decode".
+PHASE_QUEUED = "queued"
+PHASE_PREFILL = "prefill"
+PHASE_DECODE_CONTENTION = "decode_contention"
+PHASE_DECODE = "decode"
+
+VIOLATION_PHASES = (PHASE_QUEUED, PHASE_PREFILL,
+                    PHASE_DECODE_CONTENTION, PHASE_DECODE)
+
+
+@dataclass(frozen=True)
+class SLOTargets:
+    """A request's latency targets in milliseconds; None = no target."""
+    ttft_ms: float | None = None
+    tpot_ms: float | None = None
+
+    @property
+    def defined(self) -> bool:
+        return self.ttft_ms is not None or self.tpot_ms is not None
+
+
+def _positive_ms(raw: Any) -> float | None:
+    try:
+        val = float(raw)
+    except (TypeError, ValueError):
+        return None
+    return val if val > 0 else None
+
+
+def slo_from_headers(headers: Any) -> SLOTargets:
+    """Parse the client's SLO ask. Invalid / non-positive values are
+    ignored (a malformed SLO header must not fail the request — it only
+    shapes attribution, never admission)."""
+    return SLOTargets(
+        ttft_ms=_positive_ms(headers.get("x-slo-ttft-ms")),
+        tpot_ms=_positive_ms(headers.get("x-slo-tpot-ms")))
+
+
+def resolve_slo(header_slo: SLOTargets | None, rule: Any) -> SLOTargets:
+    """Per-field precedence: client header > gateway-model rule config.
+    ``rule`` is a ModelFallbackConfig (or None); its 0-valued fields mean
+    unset, mirroring ``timeout_ms``."""
+    h = header_slo or SLOTargets()
+    rule_ttft = _positive_ms(getattr(rule, "slo_ttft_ms", 0) or 0)
+    rule_tpot = _positive_ms(getattr(rule, "slo_tpot_ms", 0) or 0)
+    return SLOTargets(ttft_ms=h.ttft_ms if h.ttft_ms is not None
+                      else rule_ttft,
+                      tpot_ms=h.tpot_ms if h.tpot_ms is not None
+                      else rule_tpot)
+
+
+def evaluate(req: Any, slo: SLOTargets,
+             flight: FlightRecorder | None = None) -> dict[str, Any] | None:
+    """SLO outcome for one finished engine request.
+
+    ``req`` is a GenRequest whose lifecycle timestamps are populated
+    (t_submit always; t_admitted/t_first_token/t_done when the request
+    got that far). Returns None when no target is defined; otherwise a
+    dict carrying the targets, the measured values, ``met``, and — on a
+    violation — the attributed ``phase`` plus the per-phase breakdown
+    the attribution was computed from.
+    """
+    if not slo.defined:
+        return None
+    out: dict[str, Any] = {}
+    if slo.ttft_ms is not None:
+        out["ttft_target_ms"] = slo.ttft_ms
+    if slo.tpot_ms is not None:
+        out["tpot_target_ms"] = slo.tpot_ms
+
+    ttft_ms = None
+    if req.t_first_token is not None:
+        ttft_ms = 1000.0 * (req.t_first_token - req.t_submit)
+        out["ttft_ms"] = round(ttft_ms, 2)
+    tpot_ms = None
+    n_gen = len(req.generated)
+    if (req.t_first_token is not None and req.t_done is not None
+            and n_gen > 1 and req.t_done > req.t_first_token):
+        tpot_ms = 1000.0 * (req.t_done - req.t_first_token) / (n_gen - 1)
+        out["tpot_ms"] = round(tpot_ms, 3)
+
+    phase = None
+    if slo.ttft_ms is not None and (
+            ttft_ms is None or ttft_ms > slo.ttft_ms):
+        # TTFT violated (a request that never produced a token counts as
+        # violated — the budget was spent with nothing to show). Split
+        # the window: queued = submit → admission; the admission →
+        # first-token span is prefill, minus whatever of it the flight
+        # recorder shows was spent inside decode bursts (the interleave
+        # tax the burst clamp exists to bound).
+        t_admit = req.t_admitted
+        t_first = req.t_first_token
+        end = t_first if t_first is not None else (
+            req.t_done if req.t_done is not None else None)
+        queued_ms = (1000.0 * (t_admit - req.t_submit)
+                     if t_admit is not None
+                     else (1000.0 * (end - req.t_submit) if end else 0.0))
+        prefill_ms = (1000.0 * (end - t_admit)
+                      if t_admit is not None and end is not None
+                      and end > t_admit else 0.0)
+        contention_ms = 0.0
+        if flight is not None and t_admit is not None and end is not None:
+            contention_ms = min(prefill_ms, flight.steps_overlapping(
+                t_admit, end, flag_mask=F_DECODE))
+        compute_ms = max(0.0, prefill_ms - contention_ms)
+        shares = ((queued_ms, PHASE_QUEUED),
+                  (compute_ms, PHASE_PREFILL),
+                  (contention_ms, PHASE_DECODE_CONTENTION))
+        phase = max(shares)[1]
+        out["attribution"] = {
+            "queued_ms": round(queued_ms, 2),
+            "prefill_ms": round(compute_ms, 2),
+            "decode_contention_ms": round(contention_ms, 2),
+        }
+    elif slo.tpot_ms is not None and tpot_ms is not None \
+            and tpot_ms > slo.tpot_ms:
+        phase = PHASE_DECODE
+
+    out["met"] = phase is None
+    if phase is not None:
+        out["phase"] = phase
+    return out

@@ -100,6 +100,10 @@ class Tracer:
         self.capacity = capacity
         self._clock = clock
         self._traces: "OrderedDict[str, RequestTrace]" = OrderedDict()
+        # Traces pushed out by ring wrap — bridged to the
+        # gateway_trace_ring_evicted_total series so trace loss under
+        # load is a reading, not a surprise 404 (ISSUE 7 satellite).
+        self.evicted_total = 0
 
     @contextmanager
     def trace(self, request_id: str) -> Iterator[RequestTrace]:
@@ -110,6 +114,7 @@ class Tracer:
         self._traces.move_to_end(request_id)
         while len(self._traces) > self.capacity:
             self._traces.popitem(last=False)
+            self.evicted_total += 1
         tok_trace = _trace_var.set(tr)
         tok_span = _span_var.set(tr.root)
         try:

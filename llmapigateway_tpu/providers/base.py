@@ -20,6 +20,7 @@ from dataclasses import dataclass, field
 from typing import TYPE_CHECKING, Any, AsyncIterator, Protocol
 
 if TYPE_CHECKING:
+    from ..obs.slo import SLOTargets
     from ..reliability.deadline import Deadline
 
 
@@ -99,6 +100,11 @@ class CompletionRequest:
     stream: bool
     extra_headers: dict[str, str] = field(default_factory=dict)
     deadline: "Deadline | None" = None
+    # Per-request SLO targets (obs/slo.py; ISSUE 7). Unlike `deadline`
+    # these never abort the attempt — the local provider computes the
+    # outcome at stream end and attributes violations; remote providers
+    # may ignore them.
+    slo: "SLOTargets | None" = None
 
 
 class Provider(abc.ABC):

@@ -21,6 +21,7 @@ import uuid
 from typing import Any, AsyncIterator
 
 from ..config.schemas import ProviderDetails
+from ..obs import slo as obs_slo
 from ..obs import trace as obs_trace
 from ..obs.metrics import GatewayMetrics, get_metrics
 from ..utils.sse import SSE_DONE, format_sse
@@ -57,8 +58,15 @@ class LocalProvider(Provider):
         if req.t_first_token is None:
             return
         t_admit = req.t_admitted or req.t_submit
+        # The flight-recorder cross-link (ISSUE 7): the admit record's
+        # sequence number, so an operator can jump from this request's
+        # trace to the exact scheduler steps that served it
+        # (GET /v1/api/flight / tools/flight_report.py).
+        attrs = ({"flight_seq": req.flight_admit_seq}
+                 if req.flight_admit_seq >= 0 else {})
         obs_trace.record_span("engine.queued", layer="engine",
-                              start=req.t_submit, end=t_admit, parent=parent)
+                              start=req.t_submit, end=t_admit, parent=parent,
+                              **attrs)
         if req.prefix_lookup_ms is not None:
             # Radix prefix lookup (ISSUE 6), ran just before admission
             # stamped t_admitted; cached_tokens is the prefill span the
@@ -149,7 +157,35 @@ class LocalProvider(Provider):
             if req.t_done and n_gen > 1 and req.t_done > req.t_first_token:
                 usage["tokens_per_sec"] = round(
                     (n_gen - 1) / (req.t_done - req.t_first_token), 2)
+        slo_out = self._slo_outcome(req)
+        if slo_out is not None:
+            # SLO outcome + attribution (ISSUE 7): rides the usage object
+            # into the SSE usage frame AND the usage DB row
+            # (extract_usage_fields ingests met/phase).
+            usage["slo"] = slo_out
         return usage
+
+    def _slo_outcome(self, req) -> dict[str, Any] | None:
+        """Evaluate + record this request's SLO outcome exactly once
+        (idempotent via a stash on the request): counters on /metrics,
+        violation attributed against the engine's flight recorder."""
+        slo = obs_slo.SLOTargets(ttft_ms=req.slo_ttft_ms,
+                                 tpot_ms=req.slo_tpot_ms)
+        if not slo.defined:
+            return None
+        cached = getattr(req, "_slo_outcome_cache", None)
+        if cached is not None:
+            return cached
+        engine = getattr(self, "engine", None)
+        flight = getattr(engine, "flight", None)
+        outcome = obs_slo.evaluate(req, slo, flight)
+        if outcome["met"]:
+            self._metrics.slo_met_total.labels(engine=self.name).inc()
+        else:
+            self._metrics.slo_violated_total.labels(
+                engine=self.name, phase=outcome["phase"]).inc()
+        req._slo_outcome_cache = outcome
+        return outcome
 
     # -- the provider contract -------------------------------------------------
     async def complete(self, request: CompletionRequest,
@@ -162,6 +198,13 @@ class LocalProvider(Provider):
         except Exception as e:
             return None, CompletionError(f"invalid request for local engine: {e}",
                                          retryable=False)
+        # Gateway request id onto the engine request: the flight
+        # recorder's admit/finish/shed records carry it, linking
+        # scheduler timeline rows back to /v1/api/trace/{id} (ISSUE 7).
+        req.request_id = obs_trace.current_request_id() or ""
+        if request.slo is not None:
+            req.slo_ttft_ms = request.slo.ttft_ms
+            req.slo_tpot_ms = request.slo.tpot_ms
         try:
             await self.engine.submit(req)
         except EngineOverloaded as e:
@@ -238,6 +281,7 @@ class LocalProvider(Provider):
                         observer.on_stream_end("deadline expired")
                         self._trace_decode(req, parent,
                                            error="deadline expired")
+                        self._slo_outcome(req)
                         return None, CompletionError(
                             "deadline expired during local decode",
                             kind="timeout", retryable=False)
@@ -247,6 +291,7 @@ class LocalProvider(Provider):
         if error is not None:
             observer.on_stream_end(error)
             self._trace_decode(req, parent, error=error)
+            self._slo_outcome(req)
             return None, CompletionError(error)
         self._trace_decode(req, parent)
         text = "".join(text_parts)
@@ -277,7 +322,8 @@ class LocalProvider(Provider):
             engine=self.name)
 
         def chunk(delta_content: str | None, finish: str | None = None,
-                  role: str | None = None, usage: dict | None = None) -> bytes:
+                  role: str | None = None, usage: dict | None = None,
+                  timings: str | None = None) -> bytes:
             delta: dict[str, Any] = {}
             if role:
                 delta["role"] = role
@@ -290,9 +336,17 @@ class LocalProvider(Provider):
                              "finish_reason": finish}]}
             if usage is not None:
                 body["usage"] = usage
+            if timings:
+                # Streamed analog of the x-gateway-timings header (ISSUE 7
+                # satellite): the FULL per-phase summary — decode included,
+                # which no response-start header can carry — as the usage
+                # frame's sibling field. Extra top-level keys are ignored
+                # by OpenAI-protocol clients.
+                body["gateway_timings"] = timings
             return format_sse(body)
 
         error: str | None = None
+        traced = False
         last_t = time.monotonic()
         try:
             yield chunk(None, role="assistant")
@@ -327,9 +381,14 @@ class LocalProvider(Provider):
                         yield chunk(delta.text)
                     if delta.finish_reason is not None:
                         finish = delta.finish_reason
+            # Close the decode/drain spans BEFORE building the summary so
+            # the streamed timing field covers the whole request.
+            self._trace_decode(req, parent)
+            traced = True
             usage = self._usage(req)
             observer.on_usage(usage)
-            yield chunk(None, finish=finish or "stop", usage=usage)
+            yield chunk(None, finish=finish or "stop", usage=usage,
+                        timings=obs_trace.server_timing_header() or None)
             yield format_sse(SSE_DONE)
         finally:
             if req.finish_reason is None:
@@ -337,7 +396,12 @@ class LocalProvider(Provider):
                 # the engine to stop decoding and free the slot.
                 req.cancelled = True
             observer.on_stream_end(error)
-            self._trace_decode(req, parent, error=error)
+            if not traced:
+                self._trace_decode(req, parent, error=error)
+            # Error/disconnect exits skip the usage frame; the SLO outcome
+            # must still be counted (idempotent — the success path already
+            # recorded it inside _usage).
+            self._slo_outcome(req)
 
     async def list_models(self) -> list[dict[str, Any]] | None:
         return [{"id": self.name, "object": "model", "owned_by": "local_tpu",

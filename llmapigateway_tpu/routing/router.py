@@ -243,7 +243,8 @@ class Router:
                        provider_name: str,
                        pinned_order: list[str] | None,
                        deadline: Deadline | None = None,
-                       request_id: str = "") -> CompletionRequest:
+                       request_id: str = "",
+                       slo=None) -> CompletionRequest:
         attempt = copy.deepcopy(payload)
         attempt["model"] = target.model
         if provider_name.lower() == "openrouter":
@@ -265,7 +266,8 @@ class Router:
             headers.update(target.custom_headers)
         stream = bool(attempt.get("stream", False))
         return CompletionRequest(payload=attempt, stream=stream,
-                                 extra_headers=headers, deadline=deadline)
+                                 extra_headers=headers, deadline=deadline,
+                                 slo=slo)
 
     # -- the state machine -----------------------------------------------------
     def _start_deadline(self, rule: ModelFallbackConfig,
@@ -281,7 +283,8 @@ class Router:
     async def dispatch(self, payload: dict[str, Any], client_key: str,
                        observer_factory: Callable[[str, str], UsageObserver],
                        timeout_ms: float | None = None,
-                       request_id: str = "") -> RouteOutcome:
+                       request_id: str = "",
+                       slo=None) -> RouteOutcome:
         """Route one chat-completions payload through the fallback chain.
 
         ``observer_factory(provider, model)`` builds a fresh usage observer
@@ -289,12 +292,17 @@ class Router:
         stream, so usage is recorded exactly once. ``timeout_ms`` is the
         client's explicit budget (x-request-timeout-ms header / timeout_ms
         body field), if any. ``request_id`` is propagated on outbound
-        provider requests (and labels this request's trace spans).
+        provider requests (and labels this request's trace spans). ``slo``
+        is the client's SLO-header ask; the rule's ``slo_ttft_ms`` /
+        ``slo_tpot_ms`` defaults fill unset fields (obs/slo.py), mirroring
+        the deadline precedence chain.
         """
+        from ..obs.slo import resolve_slo
         gateway_model = str(payload.get("model", ""))
         rule = self.resolve_rule(gateway_model)
         targets = await self._ordered_targets(rule, client_key)
         deadline = self._start_deadline(rule, timeout_ms)
+        slo = resolve_slo(slo, rule)
         m = self._metrics
 
         outcome = RouteOutcome(result=None, error=None)
@@ -361,7 +369,7 @@ class Router:
                         break
                     request = self._build_attempt(
                         payload, target, target.provider, sub_order, deadline,
-                        request_id=request_id)
+                        request_id=request_id, slo=slo)
                     observer = observer_factory(target.provider, target.model)
                     outcome.attempts += 1
                     target_attempted = True

@@ -55,7 +55,8 @@ class GatewayApp:
         # default (the local-provider factory records into it too) and a
         # per-app trace ring buffer.
         self.metrics = metrics or get_metrics()
-        self.tracer = tracer or Tracer()
+        self.tracer = tracer or Tracer(
+            capacity=max(1, settings.trace_ring_size))
         self.router = Router(
             loader, self.registry, self.rotation_db,
             fallback_provider=settings.fallback_provider,
@@ -143,6 +144,8 @@ def build_app(settings: Settings | None = None,
     app.router.add_post("/v1/api/profiler/trace", profiler_api.capture_trace)
     # End-to-end request traces (router → provider → engine span trees).
     app.router.add_get("/v1/api/trace/{request_id}", obs_api.get_trace)
+    # Scheduler flight recorder: per-step/lifecycle records (ISSUE 7).
+    app.router.add_get("/v1/api/flight", obs_api.get_flight)
 
     if STATIC_DIR.exists():
         app.router.add_static("/static", STATIC_DIR)

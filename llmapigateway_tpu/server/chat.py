@@ -23,6 +23,7 @@ import json5
 from aiohttp import web
 
 from ..obs import trace as obs_trace
+from ..obs.slo import slo_from_headers
 from ..providers.base import JSONCompletion, StreamingCompletion
 from ..reliability.deadline import budget_ms_from_request
 from ..server.usage_capture import UsageCollector
@@ -49,13 +50,18 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
             status=400)
 
     timeout_ms = budget_ms_from_request(request.headers, payload)
+    # Per-request SLO ask (ISSUE 7): x-slo-ttft-ms / x-slo-tpot-ms.
+    # Rule-level defaults fill unset fields inside dispatch; the outcome
+    # (met / violated+attributed) lands on /metrics and the usage row.
+    slo = slo_from_headers(request.headers)
 
     observer_factory = functools.partial(
         _make_collector, payload=payload, gw=gw)
 
     outcome = await gw.router.dispatch(
         payload, client_api_key(request), observer_factory,
-        timeout_ms=timeout_ms, request_id=request.get("request_id", ""))
+        timeout_ms=timeout_ms, request_id=request.get("request_id", ""),
+        slo=slo)
 
     if outcome.error is not None or outcome.result is None:
         err = outcome.error
@@ -95,6 +101,15 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
                "Cache-Control": "no-cache",
                "X-Accel-Buffering": "no",
                "Connection": "keep-alive"}
+    # Streamed requests get the timing summary too (ISSUE 7 satellite):
+    # the phases known at commit time (routing, provider attempts, the
+    # engine's queued/prefill spans — everything up to first token) go in
+    # a response-start header; the local provider additionally emits the
+    # FULL summary, decode included, as the final usage frame's sibling
+    # `gateway_timings` field, where post-commit phases exist.
+    timings = obs_trace.server_timing_header()
+    if timings:
+        headers["x-gateway-timings"] = timings
     # Prepared responses bypass the header middleware; attach the id here.
     if request.get("request_id"):
         headers["x-request-id"] = request["request_id"]

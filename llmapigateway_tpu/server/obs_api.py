@@ -42,6 +42,10 @@ _ENGINE_GAUGES = (
     ("queue_wait_ms_ema", "engine_queue_wait_seconds", 1e-3),
     ("decode_ms_per_step", "engine_decode_step_seconds", 1e-3),
     ("achieved_gbps", "engine_hbm_bandwidth_bytes", 1e9),
+    # Speculative acceptance telemetry + flight-recorder loss (ISSUE 7).
+    ("spec_proposed", "engine_spec_proposed_total", 1.0),
+    ("spec_accepted", "engine_spec_accepted_total", 1.0),
+    ("flight_evicted_total", "engine_flight_ring_evicted_total", 1.0),
 )
 
 
@@ -73,6 +77,28 @@ def make_stats_collector(gw) -> "callable":
                     and isinstance(free, (int, float)):
                 metrics.engine_kv_occupancy_ratio.labels(engine=name).set(
                     max(0.0, 1.0 - free / total))
+            proposed = stats.get("spec_proposed")
+            accepted = stats.get("spec_accepted")
+            if isinstance(proposed, (int, float)) and proposed > 0 \
+                    and isinstance(accepted, (int, float)):
+                metrics.engine_spec_acceptance_ratio.labels(
+                    engine=name).set(accepted / proposed)
+        # SLO goodput (ISSUE 7): met / (met + violated) per engine,
+        # derived at scrape time from the counters the local provider
+        # increments at stream end — the violated side sums across its
+        # attribution phases.
+        met_by_engine = {key[0]: child.value
+                         for key, child in metrics.slo_met_total.children()}
+        violated_by_engine: dict[str, float] = {}
+        for key, child in metrics.slo_violated_total.children():
+            violated_by_engine[key[0]] = (
+                violated_by_engine.get(key[0], 0.0) + child.value)
+        for eng in set(met_by_engine) | set(violated_by_engine):
+            met = met_by_engine.get(eng, 0.0)
+            tot = met + violated_by_engine.get(eng, 0.0)
+            if tot > 0:
+                metrics.slo_goodput_ratio.labels(engine=eng).set(met / tot)
+        metrics.trace_ring_evicted_total.set(gw.tracer.evicted_total)
         if gw.breakers is not None:
             for name, snap in gw.breakers.snapshot().items():
                 metrics.provider_breaker_open_ratio.labels(
@@ -90,6 +116,37 @@ async def get_metrics_text(request: web.Request) -> web.Response:
         text=text,
         headers={"Content-Type":
                  "text/plain; version=0.0.4; charset=utf-8"})
+
+
+async def get_flight(request: web.Request) -> web.Response:
+    """``GET /v1/api/flight?since=<seq>`` — the scheduler flight
+    recorder's resident records, per local engine (ISSUE 7). ``since``
+    returns only records newer than that sequence number, so a poller
+    tails the ring without re-reading it; each engine block carries its
+    ring counters (seq / capacity / evicted) so loss is visible. Convert
+    to a Perfetto-loadable Chrome trace with ``tools/flight_report.py``."""
+    gw = request.app["gateway"]
+    try:
+        since = int(request.query.get("since", -1))
+    except ValueError:
+        return web.json_response(
+            {"detail": "since must be an integer sequence number"},
+            status=400)
+    engines = {}
+    for name, prov in gw.registry.instantiated():
+        engine = getattr(prov, "engine", None)
+        recorder = getattr(engine, "flight", None)
+        if recorder is None:
+            continue
+        engines[name] = {"records": recorder.snapshot(since),
+                         **recorder.stats()}
+    if not engines:
+        return web.json_response(
+            {"detail": "no local engine with an active flight recorder "
+                       "(flight_ring_size 0, or no local provider "
+                       "instantiated yet)"},
+            status=404)
+    return web.json_response({"engines": engines})
 
 
 async def get_trace(request: web.Request) -> web.Response:

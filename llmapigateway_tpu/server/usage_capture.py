@@ -42,9 +42,18 @@ def extract_usage_fields(usage: dict[str, Any]) -> dict[str, Any]:
     cost = float(usage.get("cost") or usage.get("total_cost") or 0.0)
     # Reference reports completion net of reasoning (chat_logging.py:262-263).
     completion = max(0, completion - reasoning)
+    # SLO outcome block (providers/local.py, ISSUE 7): persisted so the
+    # usage ledger can answer "which requests missed their SLO and why"
+    # after the /metrics counters have aggregated the detail away.
+    slo = usage.get("slo")
+    slo_met = slo_phase = None
+    if isinstance(slo, dict) and "met" in slo:
+        slo_met = 1 if slo.get("met") else 0
+        slo_phase = slo.get("phase")
     return {"prompt_tokens": prompt, "completion_tokens": completion,
             "total_tokens": total, "reasoning_tokens": reasoning,
-            "cached_tokens": cached, "cost": cost}
+            "cached_tokens": cached, "cost": cost,
+            "slo_met": slo_met, "slo_phase": slo_phase}
 
 
 def write_transcript(logs_dir: Path, limit: int, request_payload: dict[str, Any],

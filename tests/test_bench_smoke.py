@@ -29,6 +29,10 @@ def test_bench_emits_contract_json_line():
          "--crossover-seq", "512",
          "--shared-prefix-len", "64", "--shared-prefix-tail", "16",
          "--shared-prefix-warm", "2",
+         # Flight A/B stays at the default 96-token windows: shorter runs
+         # quantize against the scheduler's 2 ms first-token poll and
+         # read as fake recorder overhead.
+         "--flight-ab-repeats", "3",
          "--swa-preset", "tiny-mistral-test", "--swa-seq", "128",
          "--swa-prompt", "32", "--swa-batch", "2", "--swa-steps", "4"],
         capture_output=True, text=True, timeout=900, env=env, cwd=REPO)
@@ -64,4 +68,18 @@ def test_bench_emits_contract_json_line():
     assert extra["headline_8b"]["quant"] == "int8"
     # BASELINE config 3 is paged: the north-star rung measures both layouts.
     assert "paged_vs_contiguous" in extra["headline_8b"]
+    # Per-rung SLO/goodput fields (ISSUE 7): the SNIPPETS.md targets plus
+    # which of them the rung met; goodput is tok/s gated on the targets.
+    for rung in (extra["slo"], extra["headline_8b"]["slo"]):
+        for field in ("ttft_target_ms", "tpot_target_ms", "ttft_ok",
+                      "tpot_ok", "goodput_tok_s"):
+            assert field in rung, (field, rung)
+        assert rung["ttft_ok"] is None          # --skip-ttft run
+        assert isinstance(rung["tpot_ok"], bool)
+        assert rung["goodput_tok_s"] >= 0.0
+    # Flight-recorder overhead A/B (ISSUE 7 acceptance: <=2% decode
+    # throughput delta with the recorder on, best-of-N arms compared).
+    fab = extra["flight_ab"]
+    assert fab["tok_s_recorder_on"] > 0 and fab["tok_s_recorder_off"] > 0
+    assert fab["delta_pct"] <= 2.0, fab
     assert "phase_errors" not in extra, extra["phase_errors"]

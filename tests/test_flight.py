@@ -1,0 +1,220 @@
+"""Scheduler flight recorder (obs/flight.py + engine loop, ISSUE 7):
+ring semantics under fake clocks, live-engine step/lifecycle records,
+and the chaos bar — zero leaked lifecycle records (every admit has a
+matching finish) plus correct shed events on the PR 3 overload path."""
+import asyncio
+
+import jax
+import pytest
+
+from llmapigateway_tpu.config.schemas import LocalEngineConfig
+from llmapigateway_tpu.engine.engine import (EngineOverloaded, GenRequest,
+                                             InferenceEngine)
+from llmapigateway_tpu.obs import flight as fl
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# -- ring semantics (fake clocks) ---------------------------------------------
+
+def test_ring_wrap_evicts_oldest_and_counts_loss():
+    clock = FakeClock()
+    rec = fl.FlightRecorder(capacity=16, clock=clock)
+    for i in range(40):
+        clock.advance(0.001)
+        rec.record(fl.STEP, flag=fl.F_DECODE, depth=1, tokens=i)
+    assert rec.seq == 40
+    assert rec.evicted == 24
+    snap = rec.snapshot()
+    assert len(snap) == 16
+    assert [r["seq"] for r in snap] == list(range(24, 40))
+    assert rec.stats()["flight_evicted_total"] == 24
+
+
+def test_snapshot_since_tails_the_ring():
+    rec = fl.FlightRecorder(capacity=32, clock=FakeClock())
+    for _ in range(10):
+        rec.record(fl.STEP, flag=fl.F_DECODE)
+    assert [r["seq"] for r in rec.snapshot(since=6)] == [7, 8, 9]
+    assert rec.snapshot(since=9) == []
+
+
+def test_step_record_fields_and_kinds():
+    clock = FakeClock()
+    rec = fl.FlightRecorder(clock=clock)
+    rec.record(fl.STEP, flag=fl.F_PREFILL | fl.F_DECODE | fl.F_BUSY
+               | fl.F_CLAMPED, depth=4, tokens=9, chunks=2, dur_ms=20.0,
+               val=16.0, fitted_ms=3.5, active=3, free_slots=1, queued=2,
+               free_pages=7)
+    (d,) = rec.snapshot()
+    assert d["step_kind"] == "mixed"
+    assert d["busy"] and d["clamped"]
+    assert d["burst_depth"] == 4 and d["prefill_chunks"] == 2
+    assert d["decode_wall_ms"] == 16.0
+    assert d["measured_step_ms"] == 4.0          # 16 ms / depth 4
+    assert d["fitted_step_ms"] == 3.5
+    assert d["free_pages"] == 7
+    assert fl.step_kind(fl.F_DECODE | fl.F_SPEC) == "spec"
+    assert fl.step_kind(fl.F_PREFILL) == "prefill"
+
+
+def test_steps_overlapping_uses_decode_wall_only():
+    clock = FakeClock(10.0)
+    rec = fl.FlightRecorder(clock=clock)
+    # A mixed step ending at t=10: 100 ms total, decode burst 40 ms —
+    # only the decode wall may count as contention.
+    rec.record(fl.STEP, flag=fl.F_PREFILL | fl.F_DECODE, depth=4,
+               dur_ms=100.0, val=40.0)
+    assert rec.steps_overlapping(9.0, 11.0) == pytest.approx(40.0)
+    # Window covering only half the burst.
+    assert rec.steps_overlapping(9.98, 11.0) == pytest.approx(20.0)
+    # Prefill-only steps never count.
+    rec.record(fl.STEP, flag=fl.F_PREFILL, chunks=1, dur_ms=50.0)
+    assert rec.steps_overlapping(9.0, 11.0) == pytest.approx(40.0)
+
+
+def test_lifecycle_balance_counters():
+    rec = fl.FlightRecorder(clock=FakeClock())
+    rec.record(fl.ADMIT, slot=0, rid="a")
+    rec.record(fl.ADMIT, slot=1, rid="b")
+    rec.record(fl.FINISH, slot=0, rid="a")
+    rec.record(fl.SHED, rid="c")
+    s = rec.stats()
+    assert (s["flight_admits"], s["flight_finishes"],
+            s["flight_sheds"]) == (2, 1, 1)
+
+
+# -- live engine --------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+                            max_seq_len=128, prefill_chunk=32,
+                            dtype="float32", decode_burst=4,
+                            kv_page_size=16, flight_ring_size=512,
+                            prewarm_sampler_variants=False)
+    return InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
+
+
+async def _run_one(engine, prompt, max_tokens=6, rid=""):
+    req = GenRequest(prompt_ids=list(prompt), max_tokens=max_tokens,
+                     temperature=0.0, request_id=rid)
+    await engine.submit(req)
+    async for _ in engine.stream(req):
+        pass
+    return req
+
+
+async def test_engine_records_step_and_lifecycle(engine):
+    try:
+        before = engine.flight.seq
+        req = await _run_one(engine, range(2, 40), rid="flt-1")
+        snap = engine.flight.snapshot(since=before - 1)
+        kinds = [r["kind"] for r in snap]
+        assert "admit" in kinds and "finish" in kinds and "step" in kinds
+        admit = next(r for r in snap if r["kind"] == "admit")
+        finish = next(r for r in snap if r["kind"] == "finish")
+        assert admit["request_id"] == finish["request_id"] == "flt-1"
+        assert admit["queue_wait_ms"] >= 0
+        assert finish["reason"] in ("stop", "length")
+        assert finish["tokens"] == len(req.generated)
+        # The GenRequest carries the cross-link seqs the trace spans use.
+        assert req.flight_admit_seq == admit["seq"]
+        assert req.flight_done_seq == finish["seq"]
+        # Step records: at least one prefill composition and one decode.
+        steps = [r for r in snap if r["kind"] == "step"]
+        assert any(r["step_kind"] in ("prefill", "mixed") for r in steps)
+        decodes = [r for r in steps if r["step_kind"] in ("decode", "mixed")
+                   and r.get("burst_depth")]
+        assert decodes, steps
+        d = decodes[-1]
+        assert d["dur_ms"] > 0 and d["decode_wall_ms"] > 0
+        assert d["tokens"] >= 1
+        # stats() bridges the ring counters for /metrics.
+        s = engine.stats()
+        assert s["flight_seq"] == engine.flight.seq
+        assert s["flight_evicted_total"] == 0
+    finally:
+        await engine.stop()
+
+
+async def test_overload_shed_records_and_zero_leaks(engine):
+    """The PR 3 overload path through the flight plane: queue-full
+    admissions leave SHED records carrying the request id, and after the
+    backlog drains every admit record has a matching finish — zero leaked
+    lifecycle records."""
+    clock = FakeClock(500.0)
+    engine.flight = fl.FlightRecorder(capacity=1024, clock=clock)
+    qcap = engine._queue.maxsize
+    reqs, shed = [], []
+    try:
+        # submit() has no yield point before the loop runs, so the queue
+        # fills before any admission happens — deterministic overload.
+        for i in range(qcap + 3):
+            req = GenRequest(prompt_ids=list(range(2, 10)), max_tokens=3,
+                             temperature=0.0, request_id=f"ovl-{i}")
+            try:
+                await engine.submit(req)
+                reqs.append(req)
+            except EngineOverloaded:
+                shed.append(req)
+        assert len(shed) == 3
+        for req in reqs:
+            async for _ in engine.stream(req):
+                pass
+    finally:
+        await engine.stop()
+    s = engine.flight.stats()
+    assert s["flight_sheds"] == 3
+    assert s["flight_admits"] == len(reqs)
+    assert s["flight_admits"] == s["flight_finishes"], (
+        "leaked flight records: admits without a matching finish")
+    sheds = [r for r in engine.flight.snapshot() if r["kind"] == "shed"]
+    assert {r["request_id"] for r in sheds} == {f"ovl-{i}"
+                                                for i in range(qcap,
+                                                               qcap + 3)}
+    # Fake clock drove every timestamp in this recorder.
+    assert all(r["t"] >= 500.0 for r in engine.flight.snapshot())
+
+
+async def test_cancelled_requests_leave_no_leaked_records():
+    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+                            max_seq_len=128, prefill_chunk=32,
+                            dtype="float32", decode_burst=4,
+                            kv_page_size=16, flight_ring_size=256,
+                            prewarm_sampler_variants=False)
+    eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
+    try:
+        reqs = [GenRequest(prompt_ids=list(range(2, 30)), max_tokens=40,
+                           temperature=0.0, request_id=f"can-{i}")
+                for i in range(4)]
+        for r in reqs:
+            await eng.submit(r)
+        await asyncio.sleep(0.2)
+        for r in reqs:
+            r.cancelled = True
+        while any(r.finish_reason is None for r in reqs):
+            await asyncio.sleep(0.02)
+    finally:
+        await eng.stop()
+    s = eng.flight.stats()
+    assert s["flight_admits"] == s["flight_finishes"]
+
+
+def test_flight_ring_size_zero_disables(tmp_path):
+    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=1,
+                            max_seq_len=64, prefill_chunk=32,
+                            dtype="float32", flight_ring_size=0,
+                            prewarm_sampler_variants=False)
+    eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
+    assert eng.flight is None
+    assert "flight_seq" not in eng.stats()

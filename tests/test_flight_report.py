@@ -1,0 +1,122 @@
+"""tools/flight_report.py: /v1/api/flight JSON → Chrome trace-event JSON
+(Perfetto-loadable). Golden-output pinned — the converter is a wire
+format, so a diff here is a compatibility break, not a refactor."""
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "tools"))
+import flight_report  # noqa: E402
+
+REPO = Path(__file__).resolve().parent.parent
+
+FLIGHT_DOC = {"engines": {"tpu": {
+    "flight_seq": 5, "flight_capacity": 64, "flight_evicted_total": 0,
+    "records": [
+        {"seq": 0, "t": 100.0, "kind": "step", "dur_ms": 12.0,
+         "step_kind": "prefill", "busy": False, "clamped": False,
+         "prefill_chunks": 1, "tokens": 1, "active": 1, "free_slots": 1,
+         "queued": 0},
+        {"seq": 1, "t": 100.005, "kind": "admit", "slot": 0,
+         "queue_wait_ms": 2.5, "cached_tokens": 16, "queued": 0,
+         "request_id": "req-a"},
+        {"seq": 2, "t": 100.05, "kind": "step", "dur_ms": 20.0,
+         "step_kind": "decode", "busy": False, "clamped": False,
+         "burst_depth": 4, "tokens": 8, "active": 1, "free_slots": 1,
+         "queued": 0, "decode_wall_ms": 16.0, "measured_step_ms": 4.0,
+         "fitted_step_ms": 3.9},
+        {"seq": 3, "t": 100.06, "kind": "finish", "slot": 0,
+         "reason": "stop", "tokens": 9, "request_id": "req-a"},
+        {"seq": 4, "t": 100.07, "kind": "shed", "queued": 16,
+         "request_id": "req-b"},
+    ]}}}
+
+# The pinned golden output (epoch = earliest slice start = 99.988 s).
+GOLDEN_EVENTS = [
+    {"ph": "M", "pid": 1, "name": "process_name",
+     "args": {"name": "engine:tpu"}, "ts": 0},
+    {"ph": "M", "pid": 1, "tid": 0, "name": "thread_name",
+     "args": {"name": "scheduler"}, "ts": 0},
+    {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+     "args": {"name": "lifecycle"}, "ts": 0},
+    {"ph": "X", "pid": 1, "tid": 0, "name": "prefill", "cat": "step",
+     "ts": 0, "dur": 12000,
+     "args": {"seq": 0, "kind": "step", "dur_ms": 12.0,
+              "step_kind": "prefill", "busy": False, "clamped": False,
+              "prefill_chunks": 1, "tokens": 1, "active": 1,
+              "free_slots": 1, "queued": 0}},
+    {"ph": "i", "s": "p", "pid": 1, "tid": 1, "name": "admit",
+     "cat": "lifecycle", "ts": 17000,
+     "args": {"seq": 1, "kind": "admit", "slot": 0, "queue_wait_ms": 2.5,
+              "cached_tokens": 16, "queued": 0, "request_id": "req-a"}},
+    {"ph": "X", "pid": 1, "tid": 0, "name": "decode[4]", "cat": "step",
+     "ts": 42000, "dur": 20000,
+     "args": {"seq": 2, "kind": "step", "dur_ms": 20.0,
+              "step_kind": "decode", "busy": False, "clamped": False,
+              "burst_depth": 4, "tokens": 8, "active": 1, "free_slots": 1,
+              "queued": 0, "decode_wall_ms": 16.0, "measured_step_ms": 4.0,
+              "fitted_step_ms": 3.9}},
+    {"ph": "X", "pid": 1, "tid": 2, "name": "req-a", "cat": "request",
+     "ts": 17000, "dur": 55000,
+     "args": {"admit_seq": 1, "finish_seq": 3, "reason": "stop",
+              "tokens": 9, "queue_wait_ms": 2.5, "cached_tokens": 16}},
+    {"ph": "i", "s": "p", "pid": 1, "tid": 1, "name": "finish",
+     "cat": "lifecycle", "ts": 72000,
+     "args": {"seq": 3, "kind": "finish", "slot": 0, "reason": "stop",
+              "tokens": 9, "request_id": "req-a"}},
+    {"ph": "i", "s": "p", "pid": 1, "tid": 1, "name": "shed",
+     "cat": "lifecycle", "ts": 82000,
+     "args": {"seq": 4, "kind": "shed", "queued": 16,
+              "request_id": "req-b"}},
+    {"ph": "M", "pid": 1, "tid": 2, "name": "thread_name",
+     "args": {"name": "slot 0"}, "ts": 0},
+]
+
+
+def test_golden_output():
+    out = flight_report.convert(FLIGHT_DOC)
+    assert out["displayTimeUnit"] == "ms"
+    assert out["traceEvents"] == GOLDEN_EVENTS
+
+
+def test_output_is_valid_chrome_trace():
+    """Structural validity independent of the golden pin: the invariants
+    Perfetto's importer needs (the acceptance bar's "valid Chrome
+    trace-event JSON")."""
+    out = flight_report.convert(FLIGHT_DOC)
+    assert json.loads(json.dumps(out)) == out        # JSON-serializable
+    assert isinstance(out["traceEvents"], list) and out["traceEvents"]
+    for ev in out["traceEvents"]:
+        assert ev["ph"] in ("X", "i", "M"), ev
+        assert isinstance(ev["pid"], int)
+        assert isinstance(ev["name"], str) and ev["name"]
+        assert isinstance(ev["ts"], int) and ev["ts"] >= 0
+        if ev["ph"] == "X":
+            assert isinstance(ev["dur"], int) and ev["dur"] >= 0
+            assert isinstance(ev["tid"], int)
+        if ev["ph"] == "i":
+            assert ev["s"] in ("g", "p", "t")
+
+
+def test_cli_round_trip(tmp_path):
+    src = tmp_path / "flight.json"
+    src.write_text(json.dumps(FLIGHT_DOC))
+    r = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "flight_report.py"),
+         str(src)],
+        capture_output=True, text=True, timeout=60)
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["traceEvents"] == json.loads(
+        json.dumps(GOLDEN_EVENTS, sort_keys=True))
+
+
+def test_bare_records_and_bad_input():
+    single = {"records": FLIGHT_DOC["engines"]["tpu"]["records"]}
+    out = flight_report.convert(single)
+    assert any(e["ph"] == "X" for e in out["traceEvents"])
+    with pytest.raises(ValueError, match="not a flight document"):
+        flight_report.convert({"nope": 1})

@@ -1,0 +1,228 @@
+"""SLO attribution plane (obs/slo.py, ISSUE 7): target parsing and
+precedence, outcome evaluation with phase attribution against flight
+records, provider-level metric recording, and usage-DB persistence —
+all with fake timestamps/clocks (no engine needed)."""
+import pytest
+
+from llmapigateway_tpu.config.schemas import ModelFallbackConfig
+from llmapigateway_tpu.engine.engine import GenRequest
+from llmapigateway_tpu.obs import flight as fl
+from llmapigateway_tpu.obs import slo as obs_slo
+from llmapigateway_tpu.obs.metrics import GatewayMetrics, MetricsRegistry
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _rule(**kw):
+    return ModelFallbackConfig(
+        gateway_model_name="gw/x",
+        fallback_models=[{"provider": "p", "model": "m"}], **kw)
+
+
+# -- parsing + precedence -----------------------------------------------------
+
+def test_headers_parse_and_reject_garbage():
+    slo = obs_slo.slo_from_headers({"x-slo-ttft-ms": "200",
+                                    "x-slo-tpot-ms": "50.5"})
+    assert (slo.ttft_ms, slo.tpot_ms) == (200.0, 50.5)
+    assert slo.defined
+    slo = obs_slo.slo_from_headers({"x-slo-ttft-ms": "banana",
+                                    "x-slo-tpot-ms": "-3"})
+    assert (slo.ttft_ms, slo.tpot_ms) == (None, None)
+    assert not slo.defined
+    assert not obs_slo.slo_from_headers({}).defined
+
+
+def test_rule_defaults_fill_unset_fields_only():
+    rule = _rule(slo_ttft_ms=300.0, slo_tpot_ms=80.0)
+    # Header wins per field; rule fills the hole.
+    got = obs_slo.resolve_slo(obs_slo.SLOTargets(ttft_ms=150.0), rule)
+    assert (got.ttft_ms, got.tpot_ms) == (150.0, 80.0)
+    got = obs_slo.resolve_slo(None, rule)
+    assert (got.ttft_ms, got.tpot_ms) == (300.0, 80.0)
+    # 0 in the rule means unset.
+    got = obs_slo.resolve_slo(None, _rule())
+    assert not got.defined
+
+
+def test_rule_schema_accepts_slo_fields():
+    rule = _rule(slo_ttft_ms=200.0)
+    assert rule.slo_ttft_ms == 200.0 and rule.slo_tpot_ms == 0.0
+
+
+# -- evaluation + attribution -------------------------------------------------
+
+def _req(t_submit=0.0, t_admitted=None, t_first=None, t_done=None,
+         n_gen=0):
+    req = GenRequest(prompt_ids=[1, 2, 3], max_tokens=32)
+    req.t_submit = t_submit
+    req.t_admitted = t_admitted
+    req.t_first_token = t_first
+    req.t_done = t_done
+    req.generated = list(range(n_gen))
+    return req
+
+
+def test_no_targets_is_none_and_met_path():
+    assert obs_slo.evaluate(_req(), obs_slo.SLOTargets()) is None
+    req = _req(t_admitted=0.01, t_first=0.05, t_done=0.2, n_gen=10)
+    out = obs_slo.evaluate(req, obs_slo.SLOTargets(ttft_ms=200.0,
+                                                   tpot_ms=50.0))
+    assert out["met"] is True and "phase" not in out
+    assert out["ttft_ms"] == pytest.approx(50.0)
+    assert out["tpot_ms"] == pytest.approx(1000.0 * 0.15 / 9, abs=0.01)
+
+
+def test_ttft_violation_attributed_to_queue_wait():
+    # 900 ms waiting for a slot, 50 ms of prefill: the queue did it.
+    req = _req(t_admitted=0.9, t_first=0.95, t_done=1.2, n_gen=8)
+    out = obs_slo.evaluate(req, obs_slo.SLOTargets(ttft_ms=100.0))
+    assert out["met"] is False
+    assert out["phase"] == "queued"
+    assert out["attribution"]["queued_ms"] == pytest.approx(900.0)
+    assert out["attribution"]["prefill_ms"] == pytest.approx(50.0)
+
+
+def test_ttft_violation_attributed_to_prefill():
+    req = _req(t_admitted=0.005, t_first=0.5, t_done=0.8, n_gen=8)
+    out = obs_slo.evaluate(req, obs_slo.SLOTargets(ttft_ms=100.0))
+    assert out["phase"] == "prefill"
+
+
+def test_ttft_violation_attributed_to_decode_contention():
+    """Flight records show decode bursts filled most of the prefill
+    window: the violation is the interleave tax, not the prompt."""
+    clock = FakeClock()
+    rec = fl.FlightRecorder(clock=clock)
+    # Decode bursts covering [0.05, 0.45] of the admit→first window.
+    for end in (0.15, 0.25, 0.35, 0.45):
+        clock.t = end
+        rec.record(fl.STEP, flag=fl.F_DECODE | fl.F_BUSY, depth=4,
+                   dur_ms=100.0, val=100.0)
+    req = _req(t_admitted=0.01, t_first=0.5, t_done=0.9, n_gen=8)
+    out = obs_slo.evaluate(req, obs_slo.SLOTargets(ttft_ms=100.0),
+                           flight=rec)
+    assert out["phase"] == "decode_contention"
+    attr = out["attribution"]
+    assert attr["decode_contention_ms"] == pytest.approx(400.0, abs=1.0)
+    assert attr["queued_ms"] == pytest.approx(10.0)
+
+
+def test_tpot_violation_is_decode_phase():
+    # 100 ms/token against a 20 ms target; TTFT fine.
+    req = _req(t_admitted=0.001, t_first=0.01, t_done=1.01, n_gen=11)
+    out = obs_slo.evaluate(req, obs_slo.SLOTargets(ttft_ms=500.0,
+                                                   tpot_ms=20.0))
+    assert out["met"] is False and out["phase"] == "decode"
+
+
+def test_request_without_first_token_counts_as_ttft_violation():
+    req = _req(t_admitted=0.2, t_done=0.3)
+    out = obs_slo.evaluate(req, obs_slo.SLOTargets(ttft_ms=100.0))
+    assert out["met"] is False
+    assert out["phase"] in ("queued", "prefill")
+
+
+# -- provider recording (metrics counters, idempotence) -----------------------
+
+def _provider(metrics):
+    from llmapigateway_tpu.providers.local import LocalProvider
+    prov = LocalProvider.__new__(LocalProvider)      # no engine needed
+    prov.name = "tpu"
+    prov._metrics = metrics
+    return prov
+
+
+def _counter_value(metric, **labels):
+    want = tuple(labels[ln] for ln in metric.labelnames)
+    for key, child in metric.children():
+        if key == want:
+            return child.value
+    return 0.0
+
+
+def test_provider_records_outcome_once_and_usage_block():
+    metrics = GatewayMetrics(MetricsRegistry())
+    prov = _provider(metrics)
+    req = _req(t_admitted=0.9, t_first=0.95, t_done=1.2, n_gen=8)
+    req.slo_ttft_ms = 100.0
+    usage = prov._usage(req)
+    assert usage["slo"]["met"] is False
+    assert usage["slo"]["phase"] == "queued"
+    # Idempotent: the finally-path re-record must not double count.
+    assert prov._slo_outcome(req) is usage["slo"]
+    assert _counter_value(metrics.slo_violated_total,
+                          engine="tpu", phase="queued") == 1.0
+    assert _counter_value(metrics.slo_met_total, engine="tpu") == 0.0
+
+    met_req = _req(t_admitted=0.001, t_first=0.01, t_done=0.1, n_gen=8)
+    met_req.slo_ttft_ms = 500.0
+    prov._usage(met_req)
+    assert _counter_value(metrics.slo_met_total, engine="tpu") == 1.0
+    # No targets → no slo block, no counters.
+    plain = _req(t_first=0.01, t_done=0.1, n_gen=4)
+    assert "slo" not in prov._usage(plain)
+
+
+# -- persistence (usage ledger) -----------------------------------------------
+
+def test_usage_db_persists_slo_columns(tmp_path):
+    from llmapigateway_tpu.db.usage import UsageDB, UsageRecord
+    from llmapigateway_tpu.server.usage_capture import extract_usage_fields
+
+    fields = extract_usage_fields({
+        "prompt_tokens": 10, "completion_tokens": 5,
+        "slo": {"met": False, "phase": "queued", "ttft_ms": 950.0}})
+    assert fields["slo_met"] == 0 and fields["slo_phase"] == "queued"
+    met = extract_usage_fields({"prompt_tokens": 1, "slo": {"met": True}})
+    assert met["slo_met"] == 1 and met["slo_phase"] is None
+    none = extract_usage_fields({"prompt_tokens": 1})
+    assert none["slo_met"] is None and none["slo_phase"] is None
+
+    db = UsageDB(tmp_path)
+    try:
+        db.insert(UsageRecord(model="m", provider="tpu", ttft_ms=950.0,
+                              **fields))
+        db.insert(UsageRecord(model="m", provider="tpu", **met))
+        rows = db.latest()
+        assert {r["slo_phase"] for r in rows} == {"queued", None}
+        assert sorted(r["slo_met"] for r in rows) == [0, 1]
+        agg = db.aggregated("day", "2000-01-01", "2999-01-01")
+        assert agg[0]["slo_requests"] == 2
+        assert agg[0]["slo_met_requests"] == 1
+    finally:
+        db.close()
+
+
+def test_usage_db_migrates_pre_slo_schema(tmp_path):
+    """A 0.19 ledger (no slo columns) opens cleanly and gains them."""
+    import sqlite3
+    path = tmp_path / "tokens_usage.db"
+    conn = sqlite3.connect(path)
+    conn.execute("""CREATE TABLE tokens_usage (
+        id INTEGER PRIMARY KEY AUTOINCREMENT, timestamp TEXT NOT NULL,
+        prompt_tokens INTEGER DEFAULT 0, completion_tokens INTEGER DEFAULT 0,
+        total_tokens INTEGER DEFAULT 0, reasoning_tokens INTEGER DEFAULT 0,
+        cached_tokens INTEGER DEFAULT 0, cost REAL DEFAULT 0,
+        model TEXT, provider TEXT, ttft_ms REAL, tokens_per_sec REAL)""")
+    conn.execute("INSERT INTO tokens_usage (timestamp, model, provider) "
+                 "VALUES ('2026-08-01 00:00:00', 'm', 'p')")
+    conn.commit()
+    conn.close()
+
+    from llmapigateway_tpu.db.usage import UsageDB, UsageRecord
+    db = UsageDB(tmp_path)
+    try:
+        db.insert(UsageRecord(model="m2", provider="p", slo_met=1))
+        rows = db.latest()
+        assert len(rows) == 2
+        assert rows[0]["slo_met"] == 1
+        assert rows[1]["slo_met"] is None          # pre-migration row
+    finally:
+        db.close()

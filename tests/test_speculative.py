@@ -429,3 +429,65 @@ async def test_spec_greedy_parity_paged():
         assert eng.stats()["spec_tokens_per_step"] >= 1.0
     finally:
         await eng.stop()
+
+
+async def test_spec_acceptance_telemetry_and_metrics_bridge():
+    """ISSUE 7 satellite (ROADMAP item 3 stub): speculative results are
+    counted into stats() as spec_proposed/spec_accepted and bridged onto
+    the gateway_engine_spec_* /metrics series (acceptance ratio derived
+    at scrape time), under the exposition-grammar validator."""
+    rng = np.random.default_rng(2)
+    prompt = list(np.tile(rng.integers(2, 500, 6), 8))
+    # Gates forced open so drafting definitely runs (CPU wall times would
+    # otherwise close the wall gate — acceptance COUNTING is the subject).
+    eng = _engine(spec=3, spec_min_tokens_per_step=0.0,
+                  spec_wall_gate=False)
+    try:
+        await _gen(eng, prompt, max_tokens=24)
+        s = eng.stats()
+        assert s["spec_proposed"] > 0
+        assert 0 <= s["spec_accepted"] <= s["spec_proposed"]
+        assert s["spec_proposed"] == 3 * eng._spec_steps_done
+
+        # Scrape-time bridge: stats() keys → engine_spec_* gauges.
+        from llmapigateway_tpu.obs.metrics import (GatewayMetrics,
+                                                   MetricsRegistry)
+        from llmapigateway_tpu.server.obs_api import make_stats_collector
+
+        class _Prov:
+            engine = eng
+
+        class _Reg:
+            @staticmethod
+            def instantiated():
+                return [("tpu", _Prov())]
+
+        class _Tracer:
+            evicted_total = 0
+
+        class _GW:
+            metrics = GatewayMetrics(MetricsRegistry())
+            registry = _Reg()
+            breakers = None
+            tracer = _Tracer()
+
+        gw = _GW()
+        gw.metrics.registry.register_collector(make_stats_collector(gw))
+        from tests.test_metrics import validate_prometheus_text
+        families = validate_prometheus_text(gw.metrics.render())
+
+        def val(fam):
+            for _, labels, value in families[fam]["samples"]:
+                if labels.get("engine") == "tpu":
+                    return value
+            return None
+
+        assert val("gateway_engine_spec_proposed_total") == \
+            s["spec_proposed"]
+        assert val("gateway_engine_spec_accepted_total") == \
+            s["spec_accepted"]
+        ratio = val("gateway_engine_spec_acceptance_ratio")
+        assert ratio == pytest.approx(s["spec_accepted"]
+                                      / s["spec_proposed"])
+    finally:
+        await eng.stop()
