@@ -378,6 +378,17 @@ def fill_and_time_decode(engine, args, steps: int | None = None) -> dict:
             out["engine_roofline_fraction"] = es["roofline_fraction"]
     if engine.paged and engine.kv_ppb > 1:
         out["pages_per_block"] = engine.kv_ppb
+    # Device-observability rows (ISSUE 8): the rung's HBM peak (runtime
+    # allocator where the backend has one, else the ledger's static
+    # accounting) and the per-kernel cost table the roofline report's
+    # worst-kernel ranking reads (tools/roofline_report.py --kernels).
+    # Costs resolve synchronously here — the rung is already timed, and
+    # an artifact without FLOPs/bytes columns defeats the table.
+    mem = engine.ledger.device_memory()
+    out["hbm_peak_bytes"] = (mem or {}).get("peak_bytes",
+                                            engine.ledger.static_total)
+    engine.kernels.resolve_costs()
+    out["kernels"] = engine.kernel_table()
     return out
 
 
@@ -689,6 +700,48 @@ def flight_ab_rung(args) -> dict:
     }
 
 
+def annot_ab_rung(args) -> dict:
+    """Phase-annotation overhead A/B (ISSUE 8 acceptance): decode tok/s
+    through the REAL scheduler loop with the host-side TraceAnnotation
+    markers on vs off, arms alternated and the paired-median ratio
+    compared (the --flight-ab estimator) — the markers are two C-level
+    calls per dispatch, so the acceptance bar is ≤1% on decode."""
+    engine, _ = build_engine(args, "contiguous")
+    n_tok = max(16, args.annot_ab_tokens)
+    on_runs, off_runs = [], []
+
+    def one(arm: str) -> None:
+        engine.profile_annotations = arm == "on"
+        (on_runs if arm == "on" else off_runs).append(
+            scheduler_throughput(engine, args, n_tokens=n_tok))
+
+    pairs = 0
+    while True:
+        for arm in (("on", "off") if pairs % 2 == 0 else ("off", "on")):
+            one(arm)
+        pairs += 1
+        ratios = sorted(a / b for a, b in zip(on_runs, off_runs) if b > 0)
+        med = ratios[len(ratios) // 2] if ratios else 1.0
+        delta = 100.0 * (1.0 - med)
+        if pairs >= max(1, args.annot_ab_repeats) and (
+                delta <= 1.0 or pairs >= 2 * max(3, args.annot_ab_repeats)):
+            break
+    return {
+        "tok_s_annotations_on": round(max(on_runs), 1),
+        "tok_s_annotations_off": round(max(off_runs), 1),
+        # Positive = annotations cost throughput (median of paired
+        # on/off ratios); ≤1% is the acceptance bar, negative values are
+        # noise in the on arm's favor.
+        "delta_pct": round(delta, 2),
+        # Best-of comparison: robust against per-run scheduler jitter at
+        # toy scale — a true cost shows in BOTH estimators, noise rarely
+        # in both directions at once (the smoke asserts the min).
+        "delta_best_pct": round(
+            100.0 * (1.0 - max(on_runs) / max(off_runs)), 2),
+        "repeats": pairs,
+    }
+
+
 def attention_inmodel_ab(args) -> dict:
     """In-model attention A/B: the full greedy fused-scan decode step with
     the Pallas flash attention vs the jnp reference path, on real
@@ -891,6 +944,16 @@ def main() -> None:
                     help="decode tokens per request per A/B arm run")
     ap.add_argument("--flight-ab-repeats", type=int, default=3,
                     help="alternating runs per arm (best-of compared)")
+    ap.add_argument("--annot-ab", type=int, default=1,
+                    help="phase-annotation overhead A/B through the real "
+                         "scheduler: tok/s with TraceAnnotation markers "
+                         "on vs off (0 disables; acceptance bar is <=1%% "
+                         "delta on decode)")
+    ap.add_argument("--annot-ab-tokens", type=int, default=96,
+                    help="decode tokens per request per annotation A/B "
+                         "arm run")
+    ap.add_argument("--annot-ab-repeats", type=int, default=3,
+                    help="alternating annotation-A/B runs per arm")
     ap.add_argument("--max-seconds", type=float, default=1200.0,
                     help="soft deadline: optional phases are skipped once "
                          "elapsed time passes this, so the one-line JSON "
@@ -1654,6 +1717,21 @@ def main() -> None:
         except Exception as e:
             errors.append(f"flight_ab: {e!r}")
             note(f"FAILED flight A/B phase: {e!r}")
+        finally:
+            engine = None
+
+    # -- phase 4j: phase-annotation overhead A/B (ISSUE 8) -------------------
+    if args.annot_ab and not over_budget("annot_ab"):
+        try:
+            engine = None
+            extra["annotation_ab"] = annot_ab_rung(args)
+            note(f"annotation A/B: "
+                 f"{extra['annotation_ab']['tok_s_annotations_on']} on vs "
+                 f"{extra['annotation_ab']['tok_s_annotations_off']} off "
+                 f"tok/s ({extra['annotation_ab']['delta_pct']}% overhead)")
+        except Exception as e:
+            errors.append(f"annot_ab: {e!r}")
+            note(f"FAILED annotation A/B phase: {e!r}")
         finally:
             engine = None
 

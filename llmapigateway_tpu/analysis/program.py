@@ -64,9 +64,10 @@ from typing import Any, Iterable
 from .core import ChainHop, Finding, Suppressions, iter_python_files, package_relpath
 from .rules._util import dotted_name
 from .rules.async_blocking import classify_blocking_call
+from .rules.device_sync import classify_device_sync, sync_ok_marked
 from .rules.lock_discipline import _GUARDED_RE, _MUTATORS
 
-SUMMARY_VERSION = 3
+SUMMARY_VERSION = 4
 
 # Entry scope for the transitive async-blocking pass (matches the lexical
 # rule's dirs) and for the timeout dataflow seed.
@@ -233,8 +234,16 @@ class _FnCollector(ast.NodeVisitor):
     # -- calls -------------------------------------------------------------
     def visit_Call(self, node: ast.Call) -> None:
         msg = classify_blocking_call(node)
+        dmsg = classify_device_sync(node)
+        if self.s.sync_ok and dmsg is not None:
+            # Documented `# device-sync: ok` helper: its vetted syncs are
+            # exempt from BOTH transitive passes (non-sync blocking
+            # primitives — sleep, requests, file I/O — still flag).
+            msg = dmsg = None
         if msg is not None:
             self.s.blocking.append([node.lineno, msg])
+        if dmsg is not None:
+            self.s.device_syncs.append([node.lineno, dmsg])
 
         name = dotted_name(node.func)
         if name is not None:
@@ -316,6 +325,8 @@ class _FnSummary:
                        args.posonlyargs + args.args + args.kwonlyargs]
         self.calls: list[dict[str, Any]] = []
         self.blocking: list[list[Any]] = []
+        self.device_syncs: list[list[Any]] = []
+        self.sync_ok = False
         self.accesses: list[dict[str, Any]] = []
         self.httpx_bare: list[list[Any]] = []
         self.thread_refs: list[list[Any]] = []
@@ -324,6 +335,7 @@ class _FnSummary:
         return {"line": self.line, "is_async": self.is_async,
                 "class": self.class_name, "params": self.params,
                 "calls": self.calls, "blocking": self.blocking,
+                "device_syncs": self.device_syncs, "sync_ok": self.sync_ok,
                 "accesses": self.accesses, "httpx_bare": self.httpx_bare,
                 "thread_refs": self.thread_refs}
 
@@ -370,6 +382,10 @@ def summarize_module(tree: ast.Module, source: str, relpath: str) -> dict[str, A
     def collect_fn(node, qlocal: str, class_name: str | None,
                    param_types: dict[str, str]) -> None:
         summ = _FnSummary(qlocal, node, class_name)
+        # `# device-sync: ok` on the def line / signature: a documented
+        # sync helper — the device-sync pass neither reports it nor
+        # chases through it (rules/device_sync.py).
+        summ.sync_ok = sync_ok_marked(node, lines)
         # Parameter annotations naming project classes.
         args = node.args
         ptypes = dict(param_types)
@@ -680,6 +696,95 @@ class Program:
         fn = self.fn(*ref)
         return fn["line"] if fn else 0
 
+    # -- pass 1b: transitive device-sync discipline -------------------------
+    def _device_sync_findings(self) -> list[Finding]:
+        """From every serving-layer ``async def``, chase call edges into
+        ANY module (the engine/obs helpers the blocking pass's serving-
+        scope misses are exactly where device syncs hide) and flag
+        device→host syncs — except inside functions documented with
+        ``# device-sync: ok``, which are neither reported nor descended
+        through (their callees are the helper's implementation detail).
+        Thread-dispatch references create no call edge (PR 5), so
+        worker-thread fetch code is structurally exempt."""
+        findings: list[Finding] = []
+        for s in self.summaries.values():
+            rel = s["relpath"]
+            if not rel.startswith(SERVING_DIRS):
+                continue
+            for qlocal, fn in s["functions"].items():
+                if fn["is_async"] and not fn.get("sync_ok"):
+                    findings.extend(
+                        self._chase_device_sync(s["module"], qlocal, fn))
+        return findings
+
+    def _chase_device_sync(self, module: str, qlocal: str,
+                           fn: dict[str, Any]) -> list[Finding]:
+        entry_rel = self.relpath(module)
+        findings: list[Finding] = []
+        reported: set[tuple[str, int]] = set()
+        entry_fn = _pretty(qlocal)
+        # Depth 0: syncs in the coroutine's own body (the lexical rule
+        # also sees these in serving dirs; findings dedupe by location
+        # downstream of suppression handling, and the chain here names
+        # the entry explicitly).
+        for line, msg in fn.get("device_syncs", ()):
+            key = (entry_rel, line)
+            if key in reported:
+                continue
+            reported.add(key)
+            findings.append(Finding(
+                rule="device-sync-discipline", path=entry_rel, line=line,
+                col=0,
+                message=(f"async {entry_fn}() performs a device sync on "
+                         f"the event loop: {msg} — offload via "
+                         f"asyncio.to_thread or document the helper with "
+                         f"`# device-sync: ok`"),
+                chain=(ChainHop(entry_rel, line, msg),)))
+        seen = {(module, qlocal)}
+        queue: deque = deque()
+        for call in fn["calls"]:
+            tgt = self.resolve_call(module, qlocal, call["name"])
+            if tgt is None or tgt in seen:
+                continue
+            seen.add(tgt)
+            hop = ChainHop(entry_rel, call["line"],
+                           f"{entry_fn} calls {_pretty(tgt[1])} "
+                           f"({self.relpath(tgt[0])}:{self._line(tgt)})")
+            queue.append((tgt, (hop,)))
+        while queue:
+            (mod, ql), chain = queue.popleft()
+            callee = self.fn(mod, ql)
+            if callee is None or len(chain) > 8:
+                continue
+            if callee.get("sync_ok"):
+                continue            # documented helper: stop the chase
+            rel = self.relpath(mod)
+            for line, msg in callee.get("device_syncs", ()):
+                key = (rel, line)
+                if key in reported:
+                    continue
+                reported.add(key)
+                full = chain + (ChainHop(rel, line, msg),)
+                findings.append(Finding(
+                    rule="device-sync-discipline", path=entry_rel,
+                    line=chain[0].line, col=0,
+                    message=(f"async {entry_fn}() reaches a device sync "
+                             f"through {len(chain)} call hop(s): {msg} "
+                             f"[{rel}:{line}] — offload the helper via "
+                             f"asyncio.to_thread or document it with "
+                             f"`# device-sync: ok`"),
+                    chain=full))
+            for call in callee["calls"]:
+                tgt = self.resolve_call(mod, ql, call["name"])
+                if tgt is None or tgt in seen:
+                    continue
+                seen.add(tgt)
+                hop = ChainHop(rel, call["line"],
+                               f"{_pretty(ql)} calls {_pretty(tgt[1])} "
+                               f"({self.relpath(tgt[0])}:{self._line(tgt)})")
+                queue.append((tgt, chain + (hop,)))
+        return findings
+
     # -- pass 2: guarded-by inference --------------------------------------
     def _guard_index(self) -> dict[str, dict[str, str]]:
         """class name -> {attr: guard} across the whole tree (ambiguous
@@ -889,8 +994,8 @@ class Program:
 
     # -- driver -------------------------------------------------------------
     def findings(self) -> list[Finding]:
-        out = (self._blocking_findings() + self._guard_findings()
-               + self._timeout_findings())
+        out = (self._blocking_findings() + self._device_sync_findings()
+               + self._guard_findings() + self._timeout_findings())
         out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
         return out
 
@@ -949,7 +1054,8 @@ def analyze_program(paths: Iterable[str | Path],
     program = Program(summaries)
     findings = program.findings()
     out: list[Finding] = []
-    known = {"async-blocking", "lock-discipline", "timeout-discipline"}
+    known = {"async-blocking", "lock-discipline", "timeout-discipline",
+             "device-sync-discipline"}
     supp_cache: dict[str, Suppressions] = {}
     for f in findings:
         if report_only is not None and f.path not in report_only:

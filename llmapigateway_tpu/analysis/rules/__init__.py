@@ -10,6 +10,7 @@ from __future__ import annotations
 
 from ..core import Rule
 from .async_blocking import RULE as ASYNC_BLOCKING
+from .device_sync import RULE as DEVICE_SYNC
 from .exception_hygiene import RULE as EXCEPTION_HYGIENE
 from .lock_discipline import RULE as LOCK_DISCIPLINE
 from .metric_discipline import RULE as METRIC_DISCIPLINE
@@ -27,6 +28,7 @@ ALL_RULES: tuple[Rule, ...] = (
     TIMEOUT_DISCIPLINE,
     METRIC_DISCIPLINE,
     EXCEPTION_HYGIENE,
+    DEVICE_SYNC,
 )
 
 RULES_BY_NAME: dict[str, Rule] = {r.name: r for r in ALL_RULES}

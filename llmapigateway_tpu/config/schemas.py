@@ -181,6 +181,23 @@ class LocalEngineConfig(BaseModel):
     # gateway_engine_flight_ring_evicted_total series. 0 disables.
     # (Same knob pattern as the gateway-level TRACE_RING_SIZE.)
     flight_ring_size: int = 4096
+    # HBM headroom watermark (ISSUE 8): shed admissions (HTTP 429 with
+    # the engine's Retry-After hint, the PR 3 overload path) while the
+    # runtime allocator reports less than this FRACTION of device memory
+    # free — admission reacts to memory pressure before the next compile
+    # or fragmentation event OOMs mid-stream. 0 disables. Inert on
+    # backends without allocator stats (CPU reports none); the HBM
+    # ledger's gateway_engine_hbm_* gauges report the same numbers.
+    hbm_headroom_watermark: float = Field(default=0.0, ge=0.0, lt=1.0)
+    # Phase-annotated profiling (ISSUE 8): host-side jax.profiler
+    # TraceAnnotation markers (prefill / decode / spec.verify) around
+    # every compiled-program dispatch, so on-demand captures
+    # (POST /v1/api/profiler/trace) segment by scheduler phase in
+    # Perfetto. Cost is a few µs per dispatch (the bench's annotation
+    # A/B rung pins it ≤1% on decode); the in-program named_scope
+    # markers (decode.attention / decode.mlp / sampling) are trace-time
+    # metadata and cannot be disabled because they cost nothing.
+    profile_annotations: bool = True
 
 
 class BreakerSettings(BaseModel):

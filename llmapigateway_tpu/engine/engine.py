@@ -78,6 +78,7 @@ if _os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
 from ..config.schemas import LocalEngineConfig
 from ..models import forward_fn, init_fn, llama
 from ..models.config import ModelConfig, get_preset
+from ..obs.device import phase as _device_phase
 from ..parallel.mesh import MeshSpec, build_mesh
 from ..parallel.sharding import cache_sharding, param_shardings
 from .sampling import SamplingParams, sample
@@ -177,6 +178,24 @@ class Delta:
     error: str | None = None
 
 
+def _kernel_cost_fn(fn, args):
+    """AOT ``lower().compile().cost_analysis()`` closure for the kernel
+    registry (obs/device.py): capture the call's AVALS now — metadata
+    only; holding the real arrays would pin donated buffers — and do the
+    lower/compile/analyze later on the registry's resolver thread (an 8B
+    lower costs seconds; the persistent compilation cache makes the
+    compile itself a lookup)."""
+    def aval(x):
+        return jax.ShapeDtypeStruct(
+            np.shape(x), getattr(x, "dtype", None) or np.asarray(x).dtype,
+            sharding=getattr(x, "sharding", None))
+    avals = jax.tree.map(aval, args)
+
+    def cost():
+        return fn.lower(*avals).compile().cost_analysis()
+    return cost
+
+
 def _start_host_copy(arr) -> None:
     """Kick off an async device→host copy so the transfer overlaps the
     next dispatched burst. Purely an overlap optimization: backends
@@ -197,6 +216,12 @@ class InferenceEngine:
                  model_cfg: ModelConfig | None = None,
                  devices: list | None = None):
         self.cfg = engine_cfg
+        # Compile monitor FIRST (ISSUE 8): the engine build's own
+        # compiles must count under the "startup" phase — installing
+        # after init would misattribute nothing-at-all for them and make
+        # the recompile telemetry start from a lie.
+        from ..obs.device import install_compile_monitor
+        install_compile_monitor()
         if model_cfg is None:
             if engine_cfg.preset:
                 model_cfg = get_preset(engine_cfg.preset)
@@ -407,6 +432,16 @@ class InferenceEngine:
         from ..obs.flight import FlightRecorder
         self.flight = (FlightRecorder(engine_cfg.flight_ring_size)
                        if engine_cfg.flight_ring_size > 0 else None)
+        # Device observability plane (ISSUE 8): per-kernel cost registry
+        # (worker thread records, lock-guarded internally), the HBM
+        # memory ledger, and the process-wide XLA compile monitor. The
+        # ledger's watermark feeds submit()'s shed path so admission
+        # reacts to device memory pressure, not just slots/pages.
+        from ..obs.device import HbmLedger, KernelRegistry
+        self.profile_annotations = bool(engine_cfg.profile_annotations)
+        self.kernels = KernelRegistry()
+        self.ledger: HbmLedger = self._build_ledger()
+        self._watermark_sheds = 0                       # guarded-by: loop
 
     # -- initialization ------------------------------------------------------
     def _init_params(self) -> None:
@@ -858,8 +893,11 @@ class InferenceEngine:
             samp = SamplingParams(temperature=samp_t, top_p=samp_p,
                                   top_k=samp_k, presence_penalty=samp_pp,
                                   frequency_penalty=samp_fp)
-            first = jax.lax.with_sharding_constraint(
-                sample(rows, samp, key, counts=count_rows), replicated)
+            # Phase marker (ISSUE 8): trace-time op metadata only — the
+            # profiler segments sampling from the forward in Perfetto.
+            with jax.named_scope("sampling"):
+                first = jax.lax.with_sharding_constraint(
+                    sample(rows, samp, key, counts=count_rows), replicated)
             return first, counts, llama.KVCache(k=new_k, v=new_v)
 
         def one_step(params, cache: llama.KVCache, counts: jax.Array,
@@ -892,14 +930,15 @@ class InferenceEngine:
                                    tokens].add(active.astype(jnp.int32))
             logits, cache = model_forward(
                 params, c, tokens[:, None], lengths, cache, active=active)
-            if greedy:
-                next_tokens = jnp.argmax(
-                    logits[:, 0, :], axis=-1).astype(jnp.int32)
-            else:
-                next_tokens = sample(logits[:, 0, :], samp, key,
-                                     counts=counts)
-            next_tokens = jax.lax.with_sharding_constraint(
-                next_tokens, replicated)
+            with jax.named_scope("sampling"):
+                if greedy:
+                    next_tokens = jnp.argmax(
+                        logits[:, 0, :], axis=-1).astype(jnp.int32)
+                else:
+                    next_tokens = sample(logits[:, 0, :], samp, key,
+                                         counts=counts)
+                next_tokens = jax.lax.with_sharding_constraint(
+                    next_tokens, replicated)
             new_lengths = jnp.where(active, lengths + 1, lengths)
             return next_tokens, new_lengths, counts, cache
 
@@ -1046,8 +1085,9 @@ class InferenceEngine:
             samp = SamplingParams(temperature=samp_t, top_p=samp_p,
                                   top_k=samp_k, presence_penalty=samp_pp,
                                   frequency_penalty=samp_fp)
-            first = jax.lax.with_sharding_constraint(
-                sample(rows, samp, key, counts=count_rows), replicated)
+            with jax.named_scope("sampling"):
+                first = jax.lax.with_sharding_constraint(
+                    sample(rows, samp, key, counts=count_rows), replicated)
             return first, counts, PagedKVCache(k=cache.k, v=cache.v)
 
         def one_step(params, cache: PagedKVCache, counts: jax.Array,
@@ -1066,14 +1106,15 @@ class InferenceEngine:
             logits, cache = call_forward(params, cache, table,
                                          tokens[:, None], lengths,
                                          active=active)
-            if greedy:
-                next_tokens = jnp.argmax(
-                    logits[:, 0, :], axis=-1).astype(jnp.int32)
-            else:
-                next_tokens = sample(logits[:, 0, :], samp, key,
-                                     counts=counts)
-            next_tokens = jax.lax.with_sharding_constraint(
-                next_tokens, replicated)
+            with jax.named_scope("sampling"):
+                if greedy:
+                    next_tokens = jnp.argmax(
+                        logits[:, 0, :], axis=-1).astype(jnp.int32)
+                else:
+                    next_tokens = sample(logits[:, 0, :], samp, key,
+                                         counts=counts)
+                next_tokens = jax.lax.with_sharding_constraint(
+                    next_tokens, replicated)
             new_lengths = jnp.where(active, lengths + 1, lengths)
             return (next_tokens, new_lengths, counts,
                     PagedKVCache(k=cache.k, v=cache.v))
@@ -1246,6 +1287,27 @@ class InferenceEngine:
                 f"max_seq_len {self.S}")
         req.max_tokens = max(1, min(req.max_tokens,
                                     self.S - len(req.prompt_ids)))
+        # HBM headroom watermark (ISSUE 8): when the runtime allocator
+        # reports less free device memory than the configured fraction,
+        # shed at admission exactly like a full queue — 429 + Retry-After
+        # through the PR 3 path — instead of letting the next compile or
+        # fragmentation event OOM mid-stream. Inert where the backend has
+        # no allocator stats (CPU) unless a test injects a mem_fn.
+        wm = self.cfg.hbm_headroom_watermark
+        if wm > 0:
+            frac = self.ledger.headroom_fraction()
+            if frac is not None and frac < wm:
+                self._shed_n += 1
+                self._watermark_sheds += 1
+                if self.flight is not None:
+                    from ..obs.flight import SHED
+                    self.flight.record(SHED, queued=self._queue.qsize(),
+                                       free_slots=len(self._free_slots),
+                                       val=frac,
+                                       rid=req.request_id or None)
+                raise EngineOverloaded(
+                    f"device memory headroom {frac:.1%} below the "
+                    f"{wm:.0%} watermark")
         req.detok = IncrementalDetokenizer(self.tokenizer)
         try:
             self._queue.put_nowait(req)
@@ -1864,15 +1926,30 @@ class InferenceEngine:
         table = (self._device_table(),) if self.paged else ()
         if key is None:
             key = _DUMMY_KEY()
-        first, self._d_counts, cache = self._prefill_fn(
-            self.params, self.cache, self._d_counts, *table, padded,
-            np.asarray(poss, np.int32), np.asarray(slots, np.int32),
-            np.asarray([len(ch) - 1 for ch in chunks], np.int32),
-            np.asarray([s[0] for s in samps], np.float32),
-            np.asarray([s[1] for s in samps], np.float32),
-            np.asarray([s[2] for s in samps], np.int32),
-            np.asarray([s[3] for s in samps], np.float32),
-            np.asarray([s[4] for s in samps], np.float32), key)
+        args = (self.params, self.cache, self._d_counts, *table, padded,
+                np.asarray(poss, np.int32), np.asarray(slots, np.int32),
+                np.asarray([len(ch) - 1 for ch in chunks], np.int32),
+                np.asarray([s[0] for s in samps], np.float32),
+                np.asarray([s[1] for s in samps], np.float32),
+                np.asarray([s[2] for s in samps], np.int32),
+                np.asarray([s[3] for s in samps], np.float32),
+                np.asarray([s[4] for s in samps], np.float32), key)
+        # Kernel registry (ISSUE 8): one row per (bucket, K) prefill
+        # program; the aval capture + cost closure is paid once per
+        # variant. The wall is the dispatch wall (on an async backend the
+        # device time lands in the group's later fetch; CPU is
+        # synchronous) — per-step attribution for decode comes from the
+        # flight ring, prefill rows are call/FLOPs accounting.
+        kname = f"prefill.b{int(bucket)}.k{K}"
+        if self.kernels.needs(kname):
+            self.kernels.register(
+                kname, "prefill", variant={"bucket": int(bucket), "k": K},
+                cost_fn=_kernel_cost_fn(self._prefill_fn, args))
+        t0 = time.monotonic()
+        with _device_phase("prefill", annotate=self.profile_annotations):
+            first, self._d_counts, cache = self._prefill_fn(*args)
+        self.kernels.record(kname,
+                            wall_ms=1000.0 * (time.monotonic() - t0))
         return first, cache
 
     def _exec_decode(self, n_steps: int, state: dict) -> list[np.ndarray]:
@@ -2059,17 +2136,29 @@ class InferenceEngine:
         table = (self._device_table(),) if self.paged else ()
         if n_steps == self._spec_scan_len:
             t0 = time.monotonic()
-            emitted, self.cache, self._d_hist, self._d_tokens, \
-                self._d_lengths = self._spec_scan(
-                    self.params, self.cache, *table, self._d_hist,
+            args = (self.params, self.cache, *table, self._d_hist,
                     self._d_tokens, self._d_lengths, self._d_active)
-            _start_host_copy(emitted)
+            kname = f"spec.s{n_steps}"
+            if self.kernels.needs(kname):
+                self.kernels.register(
+                    kname, "spec", variant={"depth": n_steps},
+                    cost_fn=_kernel_cost_fn(self._spec_scan, args))
+            with _device_phase("spec.verify",
+                               annotate=self.profile_annotations):
+                emitted, self.cache, self._d_hist, self._d_tokens, \
+                    self._d_lengths = self._spec_scan(*args)
+                _start_host_copy(emitted)
             prev, self._spec_pending = self._spec_pending, (
                 emitted, n_steps, self.active.copy(),
                 self._slot_epoch.copy())
             before = self._spec_tokens_out
             out = pre + self._flush_spec_entry(prev)
-            if prev is not None and prev[1] == n_steps:
+            steady = prev is not None and prev[1] == n_steps
+            self.kernels.record(
+                kname, steps=n_steps,
+                wall_ms=(1000.0 * (time.monotonic() - t0) if steady
+                         else None))
+            if steady:
                 # Steady state at full spec depth: this call's wall time
                 # covers one same-depth burst (lag-one), and the flushed
                 # burst's emitted count is its token yield — feed the
@@ -2086,14 +2175,23 @@ class InferenceEngine:
         # synchronous: land the in-flight burst, then step one at a time.
         pre += self._flush_spec_pending()
         outs = []
-        for _ in range(n_steps):
-            self._d_tokens, self._d_lengths, self.cache, self._d_hist, \
-                em, _ = self._spec_step(
-                    self.params, self.cache, *table, self._d_hist,
-                    self._d_tokens, self._d_lengths, self._d_active)
-            _start_host_copy(em)
-            outs.append(em)
-        host = np.stack([np.asarray(e) for e in outs])
+        kname = "spec.step1"
+        t0 = time.monotonic()
+        with _device_phase("spec.verify", annotate=self.profile_annotations):
+            for _ in range(n_steps):
+                args = (self.params, self.cache, *table, self._d_hist,
+                        self._d_tokens, self._d_lengths, self._d_active)
+                if self.kernels.needs(kname):
+                    self.kernels.register(
+                        kname, "spec", variant={"depth": 1},
+                        cost_fn=_kernel_cost_fn(self._spec_step, args))
+                self._d_tokens, self._d_lengths, self.cache, self._d_hist, \
+                    em, _ = self._spec_step(*args)
+                _start_host_copy(em)
+                outs.append(em)
+            host = np.stack([np.asarray(e) for e in outs])
+        self.kernels.record(kname, steps=n_steps,
+                            wall_ms=1000.0 * (time.monotonic() - t0))
         return pre + self._spec_walk(host, self.active, self.active.copy())
 
     def _spec_upload(self, state: dict | None = None) -> None:
@@ -2519,12 +2617,20 @@ class InferenceEngine:
             # pending) fall through to the synchronous step loop below.
             t0 = time.monotonic()
             self._rng, key = jax.random.split(self._rng)
-            toks, self._d_tokens, self._d_lengths, self._d_counts, \
-                self.cache = scan_fn(
-                    self.params, self.cache, self._d_counts, *table,
+            args = (self.params, self.cache, self._d_counts, *table,
                     self._d_tokens, self._d_lengths, self._d_active,
                     self._d_samp, key)
-            _start_host_copy(toks)
+            kname = (f"decode.d{n_steps}."
+                     f"{'greedy' if greedy else 'sampled'}")
+            if self.kernels.needs(kname):
+                self.kernels.register(
+                    kname, "decode",
+                    variant={"depth": n_steps, "greedy": greedy},
+                    cost_fn=_kernel_cost_fn(scan_fn, args))
+            with _device_phase("decode", annotate=self.profile_annotations):
+                toks, self._d_tokens, self._d_lengths, self._d_counts, \
+                    self.cache = scan_fn(*args)
+                _start_host_copy(toks)
             prev, self._pending = self._pending, (
                 toks, n_steps, self.active.copy(), self._slot_epoch.copy(),
                 self.lengths.copy(), self.last_token.copy())
@@ -2552,22 +2658,38 @@ class InferenceEngine:
                 self._ema_step_ms_stats = (
                     ms_any if self._ema_step_ms_stats is None else
                     0.8 * self._ema_step_ms_stats + 0.2 * ms_any)
+                # Steady-pair walls are the only honest lag-one walls —
+                # transition bursts count calls but contribute no time.
+                self.kernels.record(kname, steps=n_steps, wall_ms=wall)
+            else:
+                self.kernels.record(kname, steps=n_steps)
             return out
 
         # Synchronous path: flush any in-flight burst first so tokens are
         # returned in generation order.
         pre += self._flush_pending()
         pending: list[jax.Array] = []
-        for _ in range(n_steps):
-            self._rng, key = jax.random.split(self._rng)
-            self._d_tokens, self._d_lengths, self._d_counts, self.cache = \
-                step_fn(
-                    self.params, self.cache, self._d_counts, *table,
-                    self._d_tokens, self._d_lengths, self._d_active,
-                    self._d_samp, key)
-            _start_host_copy(self._d_tokens)
-            pending.append(self._d_tokens)
-        step_tokens = [np.asarray(t) for t in pending]
+        kname = f"decode.step1.{'greedy' if greedy else 'sampled'}"
+        t0 = time.monotonic()
+        with _device_phase("decode", annotate=self.profile_annotations):
+            for _ in range(n_steps):
+                self._rng, key = jax.random.split(self._rng)
+                args = (self.params, self.cache, self._d_counts, *table,
+                        self._d_tokens, self._d_lengths, self._d_active,
+                        self._d_samp, key)
+                if self.kernels.needs(kname):
+                    self.kernels.register(
+                        kname, "decode",
+                        variant={"depth": 1, "greedy": greedy},
+                        cost_fn=_kernel_cost_fn(step_fn, args))
+                self._d_tokens, self._d_lengths, self._d_counts, \
+                    self.cache = step_fn(*args)
+                _start_host_copy(self._d_tokens)
+                pending.append(self._d_tokens)
+            step_tokens = [np.asarray(t) for t in pending]
+        # The fetch above synchronizes, so this wall is honest per call.
+        self.kernels.record(kname, steps=n_steps,
+                            wall_ms=1000.0 * (time.monotonic() - t0))
         # Mirror device-side length advance on the host (+ history for
         # mixed-mode speculative engines).
         for slot in np.nonzero(self.active)[0]:
@@ -2739,6 +2861,85 @@ class InferenceEngine:
         return int(2 * c.n_layers * c.n_kv_heads * c.head_dim * elem
                    * int(live.sum()))
 
+    def _build_ledger(self):
+        """Static HBM accounting (ISSUE 8): what the engine INTENDS to
+        hold in device memory — parameter bytes at their checkpoint
+        dtypes, KV-pool bytes from page geometry × cache dtype (incl.
+        int8-KV scale planes), penalty/table auxiliaries, and the spec
+        history twin — reconciled at scrape time against the live
+        buffers' metadata and, where the backend has an allocator
+        (TPU), ``device.memory_stats()``. All byte totals are GLOBAL
+        (logical array bytes across the mesh), matching what
+        ``tracked_fn`` sums."""
+        from ..obs.device import HbmLedger, device_memory_stats
+        c = self.model_cfg
+        if self.kv_quant:
+            kv_elem, kv_scale = 1, 4        # int8 K/V + fp32/token scale
+        else:
+            kv_elem, kv_scale = int(np.dtype(self.dtype).itemsize), 0
+        page = self.kv_page
+        if self.paged:
+            tokens = self.allocator.num_pages * page
+            page_bytes = 2 * c.n_layers * c.n_kv_heads * page * (
+                c.head_dim * kv_elem + kv_scale)
+        else:
+            tokens = self.B * self.S
+            page_bytes = 0
+        kv_pool = 2 * c.n_layers * c.n_kv_heads * tokens * (
+            c.head_dim * kv_elem + kv_scale)
+        aux = self.B * c.vocab_size * 4          # penalty counts [B, V]
+        if self.paged:
+            aux += int(self.allocator.table.size) * 4   # device page table
+        spec = self.B * self.S * 4 if self.spec_k else 0  # device hist
+
+        def tracked() -> int:
+            # Live buffer bytes: array METADATA only — never a device
+            # sync. Params + KV cache + the big auxiliaries; the tiny
+            # per-slot mirrors fall inside the reconciliation band.
+            total = 0
+            for leaf in jax.tree.leaves((self.params, self.cache)):
+                itemsize = (0.5 if leaf.dtype == jnp.int4
+                            else leaf.dtype.itemsize)
+                total += int(np.prod(leaf.shape) * itemsize)
+            for extra in (self._d_counts, getattr(self, "_d_hist", None),
+                          self._d_table if self.paged else None):
+                if extra is not None:
+                    total += int(np.prod(extra.shape)
+                                 * extra.dtype.itemsize)
+            return total
+
+        try:
+            pidx = jax.process_index()
+            local = [d for d in self.mesh.devices.flat
+                     if d.process_index == pidx] or None
+        except Exception:
+            # Best-effort device scoping: fall back to all local devices
+            # inside device_memory_stats (the numbers stay correct for
+            # single-engine processes, which is every deployment today).
+            logger.debug("mesh-local device scoping failed", exc_info=True)
+            local = None
+        return HbmLedger(
+            weights=self._resident_param_bytes(), kv_pool=kv_pool,
+            aux=aux, spec=spec, page_bytes=page_bytes, tracked_fn=tracked,
+            mem_fn=lambda: device_memory_stats(local))
+
+    def kernel_table(self) -> list[dict[str, Any]]:
+        """Per-kernel roofline rows (obs/device.py) joined with the
+        flight ring's measured step walls — what ``GET /v1/api/roofline``
+        serves. Decode/spec rows carry the engine's bytes-touched model
+        (same formula as the aggregate ``hbm_bytes_per_step``, so the
+        table reconciles with it by construction); prefill rows report
+        the XLA static analysis only (prefill is FLOPs-bound)."""
+        def bytes_for(kind: str) -> int | None:
+            if kind in ("decode", "spec"):
+                return (self._resident_param_bytes()
+                        + self._kv_bytes_per_step())
+            return None
+        return self.kernels.table(
+            bytes_per_step_fn=bytes_for, peak_gbps=self.cfg.hbm_peak_gbps,
+            flight=(self.flight.snapshot() if self.flight is not None
+                    else None))
+
     def stats(self) -> dict[str, Any]:
         out = {
             "running": len(self._running),
@@ -2823,6 +3024,18 @@ class InferenceEngine:
             # under load, and lifecycle balance — bridged onto /metrics
             # by the obs collector like the prefix/shed counters.
             out.update(self.flight.stats())
+        # Device observability plane (ISSUE 8): the HBM ledger (static
+        # intent, live buffer bytes, runtime allocator where available),
+        # kernel-registry counters, watermark sheds, and the process-wide
+        # XLA compile monitor (identical across engines in one process).
+        out.update(self.ledger.snapshot(
+            prefix_resident_pages=out.get("prefix_resident_pages", 0)))
+        out.update(self.kernels.stats())
+        out["watermark_sheds"] = self._watermark_sheds
+        from ..obs.device import compile_monitor
+        cm = compile_monitor().stats()
+        out["xla_compile_total"] = cm["xla_compile_total"]
+        out["xla_compile_seconds"] = cm["xla_compile_seconds"]
         if self.spec_k:
             out["spec_draft_len"] = self.spec_k
             # Speculative acceptance telemetry (ROADMAP item 3 stub):

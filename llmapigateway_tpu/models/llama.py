@@ -611,27 +611,37 @@ def forward(params: Params, config: ModelConfig, tokens: jax.Array,
     decode_attend = getattr(attention_fn, "decode", None) if T == 1 else \
         getattr(attention_fn, "verify", None)
 
+    # Phase markers (ISSUE 8): named_scope is trace-time op metadata —
+    # zero runtime cost — so profiler captures segment each layer into
+    # its attention and MLP halves in Perfetto. "decode" = the deferred-
+    # insert path (T=1 decode and the speculative verify), "prefill" =
+    # the insert-then-attend chunk path.
+    scope = "decode" if decode_attend is not None else "prefill"
+
     def layer_step(x, scanned):
         lp, layer_k, layer_v = scanned
         # Attention block
-        h = rms_norm(x, lp["attn_norm"], c.rms_eps, c.rms_offset)
-        q, k, v = qkv_proj(h, lp, c)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        if decode_attend is not None:
-            attn = decode_attend(q, k, v, layer_k, layer_v, lengths, active)
-            ys = (k, v)                       # stacked for insert_all below
-        else:
-            attn, layer_k, layer_v = attention_fn(
-                q, k, v, layer_k, layer_v, lengths, active)
-            ys = (layer_k, layer_v)
-        x = x + mm(attn, lp["wo"])
+        with jax.named_scope(f"{scope}.attention"):
+            h = rms_norm(x, lp["attn_norm"], c.rms_eps, c.rms_offset)
+            q, k, v = qkv_proj(h, lp, c)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            if decode_attend is not None:
+                attn = decode_attend(q, k, v, layer_k, layer_v, lengths,
+                                     active)
+                ys = (k, v)                   # stacked for insert_all below
+            else:
+                attn, layer_k, layer_v = attention_fn(
+                    q, k, v, layer_k, layer_v, lengths, active)
+                ys = (layer_k, layer_v)
+            x = x + mm(attn, lp["wo"])
         # MLP block
-        h = rms_norm(x, lp["mlp_norm"], c.rms_eps, c.rms_offset)
-        if custom_mlp is not None:
-            x = x + custom_mlp(h, lp)
-        else:
-            x = x + swiglu_mlp(h, lp["wg"], lp["wu"], lp["wd"], c.act)
+        with jax.named_scope(f"{scope}.mlp"):
+            h = rms_norm(x, lp["mlp_norm"], c.rms_eps, c.rms_offset)
+            if custom_mlp is not None:
+                x = x + custom_mlp(h, lp)
+            else:
+                x = x + swiglu_mlp(h, lp["wg"], lp["wu"], lp["wd"], c.act)
         return x, ys
 
     x, (ys_k, ys_v) = jax.lax.scan(

@@ -47,9 +47,14 @@ ADMIT = 2         # request got a slot (queue-wait + prefix-hit accounting)
 FINISH = 3        # request left its slot (any reason, incl. cancel)
 SHED = 4          # admission refused on a full queue (gateway 429 path)
 EVICT = 5         # prefix-cache eviction under page pressure
+PROF = 6          # profiler capture start/stop (ISSUE 8): rid = trace dir
 
 KIND_NAMES = {STEP: "step", ADMIT: "admit", FINISH: "finish",
-              SHED: "shed", EVICT: "evict"}
+              SHED: "shed", EVICT: "evict", PROF: "profile"}
+
+# PROF flag values (capture lifecycle).
+PROF_START = 1
+PROF_STOP = 2
 
 # STEP flag bits: what the scheduler iteration actually ran.
 F_PREFILL = 1     # >=1 prefill chunk dispatched
@@ -222,6 +227,13 @@ class FlightRecorder:
                 d["pages_evicted"] = int(row["val"])
                 if row["free_pages"] >= 0:
                     d["free_pages"] = int(row["free_pages"])
+            elif kind == PROF:
+                # Profiler capture boundary (ISSUE 8): the rid carries
+                # the capture's trace directory, so a Perfetto timeline
+                # built from this ring cross-links to the XLA capture
+                # that covered these seqs.
+                d["phase"] = ("start" if int(row["flag"]) == PROF_START
+                              else "stop")
             rid = self._rid[i]
             if rid:
                 d["request_id"] = rid

@@ -466,6 +466,73 @@ class GatewayMetrics:
             "gateway_engine_flight_ring_evicted_total",
             "Flight-recorder records lost to ring wrap.", ("engine",))
 
+        # -- HBM memory ledger (ISSUE 8; obs/device.py). Static accounting
+        #    from checkpoint dtypes + cache geometry, the live buffers'
+        #    metadata bytes, and the runtime allocator's own view where
+        #    the backend exposes one (TPU; CPU reports none). -------------
+        self.engine_hbm_weights_bytes = r.gauge(
+            "gateway_engine_hbm_weights_bytes",
+            "Resident parameter bytes (scales included) per the ledger.",
+            ("engine",))
+        self.engine_hbm_kv_pool_bytes = r.gauge(
+            "gateway_engine_hbm_kv_pool_bytes",
+            "KV-pool bytes from page geometry × cache dtype (incl. int8 "
+            "scale planes).", ("engine",))
+        self.engine_hbm_aux_bytes = r.gauge(
+            "gateway_engine_hbm_aux_bytes",
+            "Auxiliary device buffers: penalty counts, page table.",
+            ("engine",))
+        self.engine_hbm_spec_bytes = r.gauge(
+            "gateway_engine_hbm_spec_bytes",
+            "Speculative-decoding device buffers (token-history twin).",
+            ("engine",))
+        self.engine_hbm_ledger_bytes = r.gauge(
+            "gateway_engine_hbm_ledger_bytes",
+            "Total bytes the ledger expects resident (weights + KV pool "
+            "+ aux + spec).", ("engine",))
+        self.engine_hbm_tracked_bytes = r.gauge(
+            "gateway_engine_hbm_tracked_bytes",
+            "Bytes the engine's live device buffers actually occupy "
+            "(array metadata; reconciles against the ledger).",
+            ("engine",))
+        self.engine_hbm_prefix_resident_bytes = r.gauge(
+            "gateway_engine_hbm_prefix_resident_bytes",
+            "KV-pool bytes held by radix-prefix-cache resident pages.",
+            ("engine",))
+        self.engine_hbm_device_in_use_bytes = r.gauge(
+            "gateway_engine_hbm_device_in_use_bytes",
+            "Runtime allocator bytes_in_use summed over the engine's "
+            "local devices.", ("engine",))
+        self.engine_hbm_device_peak_bytes = r.gauge(
+            "gateway_engine_hbm_device_peak_bytes",
+            "Runtime allocator peak_bytes_in_use summed over the "
+            "engine's local devices.", ("engine",))
+        self.engine_hbm_device_limit_bytes = r.gauge(
+            "gateway_engine_hbm_device_limit_bytes",
+            "Runtime allocator bytes_limit summed over the engine's "
+            "local devices.", ("engine",))
+        self.engine_hbm_headroom_ratio = r.gauge(
+            "gateway_engine_hbm_headroom_ratio",
+            "Free fraction of the device memory limit (the watermark "
+            "shed threshold compares against this).", ("engine",))
+        self.engine_watermark_sheds_total = r.gauge(
+            "gateway_engine_watermark_sheds_total",
+            "Admissions shed because device memory headroom fell below "
+            "the configured watermark.", ("engine",))
+        # XLA compile telemetry (ISSUE 8): process-wide monitor bridged
+        # at scrape time; a compile during a serving phase is a
+        # recompile some request paid for.
+        self.engine_xla_compile_total = r.gauge(
+            "gateway_engine_xla_compile_total",
+            "Backend (XLA) compiles observed in this process, by the "
+            "scheduler phase that triggered them (startup = engine "
+            "build / prewarm; cost_analysis = the kernel registry's own "
+            "AOT lowers).", ("phase",))
+        self.engine_xla_compile_seconds = r.gauge(
+            "gateway_engine_xla_compile_seconds",
+            "Cumulative backend-compile wall seconds, by phase.",
+            ("phase",))
+
         # -- SLO / goodput attribution plane (ISSUE 7; obs/slo.py) ------------
         self.slo_met_total = r.counter(
             "gateway_slo_met_total",

@@ -621,6 +621,14 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
         return val
 
     def attention_fn(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
+        # Phase marker (ISSUE 8): trace-time metadata so captures name
+        # the paged kernels inside the layer's attention scope.
+        with jax.named_scope("attention.paged_prefill"):
+            return _attention_fn(q, k_new, v_new, layer_k, layer_v,
+                                 lengths, active)
+
+    def _attention_fn(q, k_new, v_new, layer_k, layer_v, lengths,
+                      active=None):
         B, T, H, Dh = q.shape
         quant = isinstance(layer_k, dict)
         KV = (layer_k["q"] if quant else layer_k).shape[1]
@@ -657,6 +665,11 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
 
     def decode(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
         """Deferred-decode: stale pool + self column, no insert."""
+        with jax.named_scope("attention.paged_decode"):
+            return _decode(q, k_new, v_new, layer_k, layer_v, lengths,
+                           active)
+
+    def _decode(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
         B, T, H, Dh = q.shape
         quant = isinstance(layer_k, dict)
         KV = (layer_k["q"] if quant else layer_k).shape[1]

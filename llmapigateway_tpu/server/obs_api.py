@@ -46,6 +46,21 @@ _ENGINE_GAUGES = (
     ("spec_proposed", "engine_spec_proposed_total", 1.0),
     ("spec_accepted", "engine_spec_accepted_total", 1.0),
     ("flight_evicted_total", "engine_flight_ring_evicted_total", 1.0),
+    # HBM memory ledger (ISSUE 8): static accounting, live buffer bytes,
+    # and the runtime allocator's view (device_* keys only exist where
+    # the backend exposes memory_stats — TPU yes, CPU no).
+    ("hbm_weights_bytes", "engine_hbm_weights_bytes", 1.0),
+    ("hbm_kv_pool_bytes", "engine_hbm_kv_pool_bytes", 1.0),
+    ("hbm_aux_bytes", "engine_hbm_aux_bytes", 1.0),
+    ("hbm_spec_bytes", "engine_hbm_spec_bytes", 1.0),
+    ("hbm_ledger_bytes", "engine_hbm_ledger_bytes", 1.0),
+    ("hbm_tracked_bytes", "engine_hbm_tracked_bytes", 1.0),
+    ("hbm_prefix_resident_bytes", "engine_hbm_prefix_resident_bytes", 1.0),
+    ("hbm_device_in_use_bytes", "engine_hbm_device_in_use_bytes", 1.0),
+    ("hbm_device_peak_bytes", "engine_hbm_device_peak_bytes", 1.0),
+    ("hbm_device_limit_bytes", "engine_hbm_device_limit_bytes", 1.0),
+    ("hbm_headroom_ratio", "engine_hbm_headroom_ratio", 1.0),
+    ("watermark_sheds", "engine_watermark_sheds_total", 1.0),
 )
 
 
@@ -99,6 +114,19 @@ def make_stats_collector(gw) -> "callable":
             if tot > 0:
                 metrics.slo_goodput_ratio.labels(engine=eng).set(met / tot)
         metrics.trace_ring_evicted_total.set(gw.tracer.evicted_total)
+        # XLA compile telemetry (ISSUE 8): process-wide monitor, one
+        # series per triggering phase — a non-startup phase here is a
+        # recompile some live request paid for.
+        try:
+            from ..obs.device import compile_monitor
+            cm = compile_monitor().stats()
+            for ph, slot in cm.get("xla_compile_by_phase", {}).items():
+                metrics.engine_xla_compile_total.labels(phase=ph).set(
+                    slot["count"])
+                metrics.engine_xla_compile_seconds.labels(phase=ph).set(
+                    slot["seconds"])
+        except Exception:
+            logger.debug("xla compile bridge failed", exc_info=True)
         if gw.breakers is not None:
             for name, snap in gw.breakers.snapshot().items():
                 metrics.provider_breaker_open_ratio.labels(

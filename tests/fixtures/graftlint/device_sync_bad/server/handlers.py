@@ -1,0 +1,27 @@
+"""KNOWN-BAD fixture: serving-layer async defs reaching device syncs.
+
+``handler`` reaches a device->host fetch through a sync helper in
+another module (the transitive case); ``gauge`` performs one lexically
+(the per-file rule's case).
+"""
+import jax.numpy as jnp
+import numpy as np
+
+from ..state import device
+
+
+async def handler(request):
+    # One call hop away: state/device.py fetches synchronously.
+    return device.fetch_gauge(request.app["arr"])
+
+
+async def gauge(request):
+    # Lexically in the coroutine: np.asarray of a JAX value.
+    arr = request.app["arr"]
+    return float(np.asarray(jnp.sum(arr)))
+
+
+async def waits(request):
+    # The quiet spelling: .block_until_ready() on an array.
+    request.app["arr"].block_until_ready()
+    return None

@@ -1,0 +1,10 @@
+"""Sync helper module: undocumented device fetch reachable from the
+serving layer — the transitive pass must chain through here."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def fetch_gauge(arr):
+    # Undocumented helper: reachable from an async def, this is a
+    # silent event-loop stall through a device round trip.
+    return float(np.asarray(jnp.sum(arr)))

@@ -1,0 +1,20 @@
+"""KNOWN-GOOD fixture: the same shapes, disciplined.
+
+The fetch helper is dispatched to a worker thread (no call edge — PR 5
+records to_thread references as dispatch sites, not calls), and the
+loop-side helper that touches only host data is documented with
+``# device-sync: ok``.
+"""
+import asyncio
+
+from ..state import device
+
+
+async def handler(request):
+    # Worker-thread dispatch: blocking/syncing is the point there.
+    return await asyncio.to_thread(device.fetch_gauge, request.app["arr"])
+
+
+async def cheap(request):
+    # Documented helper: reads host mirrors only.
+    return device.host_stats(request.app["arr"])

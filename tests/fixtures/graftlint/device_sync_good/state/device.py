@@ -1,0 +1,16 @@
+"""Sync helpers: one is a worker-thread payload (reached only via
+to_thread — never flagged), one is a DOCUMENTED loop-side sync (marked
+``# device-sync: ok``: a human checked the fetch is a replicated scalar
+whose transfer already completed — the marker is the documentation)."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def fetch_gauge(arr):
+    # Reached only through asyncio.to_thread: fetching here is correct.
+    return float(np.asarray(jnp.sum(arr)))
+
+
+def host_stats(arr):  # device-sync: ok — scalar gauge, copy already landed
+    return {"gauge": float(np.asarray(jnp.max(arr))),
+            "elems": int(np.prod(arr.shape))}

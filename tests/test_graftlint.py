@@ -63,8 +63,11 @@ ASYNC_GOOD = """
 
 
 def test_async_blocking_fires_on_bad():
-    findings = lint(ASYNC_BAD, "server/fixture.py")
-    assert {f.rule for f in findings} == {"async-blocking"}
+    # device-sync-discipline overlaps on the JAX-sync subset (its own
+    # fixtures assert that separation); this test pins async-blocking's
+    # coverage specifically.
+    findings = [f for f in lint(ASYNC_BAD, "server/fixture.py")
+                if f.rule == "async-blocking"]
     # Every listed blocking primitive is caught.
     msgs = " | ".join(f.message for f in findings)
     for needle in ("time.sleep", "requests", "sqlite3",
@@ -462,6 +465,56 @@ def test_exception_hygiene_scoped_to_serving_and_engine():
     # not this rule's business.
     assert "exception-hygiene" not in rules_hit(EXC_BAD, "server/fixture.py")
     assert "exception-hygiene" in rules_hit(EXC_BAD, "engine/fixture.py")
+
+
+DEVICE_SYNC_BAD = """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    async def handler(request):
+        arr = request.app["arr"]
+        arr.block_until_ready()
+        host = np.asarray(jnp.sum(arr))
+        v = float(jnp.max(arr))
+        return host, v
+"""
+
+DEVICE_SYNC_GOOD = """
+    import asyncio
+    import numpy as np
+
+    async def handler(request):
+        arr = request.app["arr"]
+        host = await asyncio.to_thread(np.asarray, arr)
+        counts = np.asarray(request.app["host_list"])   # host data: no jnp
+        return host, counts
+
+    async def documented(request):  # device-sync: ok — replicated scalar
+        return float(jnp.max(request.app["gauge"]))
+"""
+
+
+def test_device_sync_fires_on_bad():
+    findings = [f for f in lint(DEVICE_SYNC_BAD, "server/fixture.py")
+                if f.rule == "device-sync-discipline"]
+    msgs = " | ".join(f.message for f in findings)
+    for needle in (".block_until_ready()", "np.asarray()", "float()"):
+        assert needle in msgs, needle
+    assert len(findings) == 3
+
+
+def test_device_sync_silent_on_good():
+    # to_thread dispatch, host-only asarray, and the `# device-sync: ok`
+    # marker all pass (async-blocking stays silent too: to_thread
+    # payloads are the sanctioned offload).
+    hit = rules_hit(DEVICE_SYNC_GOOD, "server/fixture.py")
+    assert "device-sync-discipline" not in hit
+
+
+def test_device_sync_scoped_to_serving_dirs():
+    assert "device-sync-discipline" not in rules_hit(
+        DEVICE_SYNC_BAD, "engine/fixture.py")
 
 
 # -- suppressions -------------------------------------------------------------

@@ -73,6 +73,10 @@ class ObsGateway:
                                 # DEFAULTS here; the small page makes chat
                                 # prompts span shareable blocks.
                                 "kv_page_size": 16,
+                                # A (toy) HBM peak so the per-kernel
+                                # roofline fractions + worst-kernel pick
+                                # engage on CPU (ISSUE 8).
+                                "hbm_peak_gbps": 1.0,
                                 "max_tokens_default": 8}}},
         ]
         rules = [
@@ -289,6 +293,71 @@ async def test_metrics_exposition_grammar_and_layer_coverage(tmp_path,
     # The chat route label is the route template, status-split.
     assert sample_value("gateway_http_requests_total",
                         path="/v1/chat/completions", status="200") >= 2
+
+    # HBM ledger series (ISSUE 8): static accounting and live buffer
+    # bytes per engine, through the same grammar validator. On the CPU
+    # backend there are no allocator stats, so the device_* families may
+    # legitimately carry no samples — the ledger families must.
+    for fam in ("gateway_engine_hbm_weights_bytes",
+                "gateway_engine_hbm_kv_pool_bytes",
+                "gateway_engine_hbm_ledger_bytes",
+                "gateway_engine_hbm_tracked_bytes"):
+        assert sample_value(fam, engine="tpu") > 0, fam
+    ledger = sample_value("gateway_engine_hbm_ledger_bytes", engine="tpu")
+    tracked = sample_value("gateway_engine_hbm_tracked_bytes",
+                           engine="tpu")
+    assert abs(ledger - tracked) <= max(0.10 * tracked, 1 << 20)
+    assert sample_value("gateway_engine_watermark_sheds_total",
+                        engine="tpu") == 0
+    # XLA compile telemetry: the engine build itself compiled, so the
+    # startup phase has a count and nonzero wall.
+    assert sample_value("gateway_engine_xla_compile_total",
+                        phase="startup") >= 1
+    assert sample_value("gateway_engine_xla_compile_seconds",
+                        phase="startup") > 0
+
+
+async def test_roofline_per_kernel_table_and_hbm_ledger(tmp_path,
+                                                        local_factory):
+    """ISSUE 8 acceptance: after serving a local request,
+    GET /v1/api/roofline carries a per-kernel table with ≥2 distinct
+    kernels whose decode rows' bytes/step reconcile with the aggregate
+    ``hbm_bytes_per_step`` within 10%, names the single worst kernel,
+    and exposes the HBM ledger alongside."""
+    async with ObsGateway(tmp_path, local_factory) as g:
+        resp = await g.client.post(
+            "/v1/chat/completions",
+            json={"model": "gw/local-direct", "stream": True,
+                  "max_tokens": 4,
+                  "messages": [{"role": "user", "content": "roofline"}]})
+        await read_sse_frames(resp)
+
+        # Resolve pending cost closures synchronously so the table rows
+        # carry the cost_analysis columns deterministically.
+        g.local_factory.engines["tpu"].kernels.resolve_costs()
+        resp = await g.client.get("/v1/api/roofline")
+        assert resp.status == 200
+        block = (await resp.json())["engines"]["tpu"]
+
+    # Aggregate keys survive (backward-compatible endpoint shape).
+    assert "hbm_bytes_per_step" in block
+    rows = block["kernels"]
+    assert len({r["kernel"] for r in rows}) >= 2, rows
+    kinds = {r["kind"] for r in rows}
+    assert "prefill" in kinds and "decode" in kinds
+    agg = block["hbm_bytes_per_step"]
+    decode_rows = [r for r in rows if r["kind"] == "decode"]
+    assert decode_rows
+    for r in decode_rows:
+        assert abs(r["hbm_bytes_per_step"] - agg) <= 0.10 * agg, (r, agg)
+    # Walls measured (flight join or dispatch walls) → fractions → a
+    # nameable worst kernel (hbm_peak_gbps is set on this engine).
+    assert block["worst_kernel"] in {r["kernel"] for r in rows}
+    assert any("xla_flops_per_call" in r for r in rows), rows
+    # The ledger block reconciles (static intent vs live buffers).
+    hbm = block["hbm"]
+    assert abs(hbm["hbm_ledger_bytes"] - hbm["hbm_tracked_bytes"]) \
+        <= max(0.10 * hbm["hbm_tracked_bytes"], 1 << 20)
 
 
 async def test_metrics_endpoint_is_unauthenticated_and_unlogged(

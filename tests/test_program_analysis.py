@@ -73,6 +73,32 @@ def test_transitive_good_package_is_clean():
     assert analyze_program([FIXTURES / "transitive_good"]) == []
 
 
+def test_device_sync_bad_package_fires_with_chains():
+    findings = analyze_program([FIXTURES / "device_sync_bad"])
+    rules = _by_rule(findings)
+    ds = rules["device-sync-discipline"]
+    # Every entry anchors at the serving layer.
+    assert {f.path for f in ds} == {"server/handlers.py"}
+    # The transitive case: handler -> state/device.py fetch, full chain.
+    hop = next(f for f in ds if "call hop" in f.message)
+    assert "state/device.py" in hop.message
+    assert hop.chain[-1].path == "state/device.py"
+    # The lexical cases rode along (np.asarray + .block_until_ready).
+    msgs = " | ".join(f.message for f in ds)
+    assert "np.asarray()" in msgs or "float()" in msgs
+    assert ".block_until_ready()" in msgs
+    # async-blocking overlaps only on its own float()-of-jax subset.
+    assert set(rules) <= {"device-sync-discipline", "async-blocking"}
+
+
+def test_device_sync_good_package_is_clean():
+    """to_thread dispatch creates no edge, and the `# device-sync: ok`
+    marker exempts the documented helper from BOTH transitive passes
+    (a marked helper's vetted fetch must not resurface as
+    async-blocking)."""
+    assert analyze_program([FIXTURES / "device_sync_good"]) == []
+
+
 def test_program_findings_respect_suppressions(tmp_path):
     pkg = tmp_path / "server"
     pkg.mkdir()

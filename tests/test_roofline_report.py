@@ -70,6 +70,44 @@ def test_real_r5_ladder_parses_if_present():
     assert "quant_int8" in by_rung
 
 
+def test_kernel_rows_ranked_worst_first(tmp_path, capsys):
+    """ISSUE 8: rungs carrying per-kernel cost rows flatten into a second
+    table ranked by ascending roofline fraction (unmeasured kernels
+    last), with the rung path attached."""
+    p = _write(tmp_path, {
+        "value": 1391.1,
+        "extra": {
+            "tok_s": 1391.1,
+            "kernels": [
+                {"kernel": "decode.d16.greedy", "kind": "decode",
+                 "calls": 10, "steps": 160, "step_ms": 23.0,
+                 "pct_of_step_time": 80.0, "hbm_bytes_per_step": 9.0e9,
+                 "achieved_gbps": 391.0, "roofline_fraction": 0.478},
+                {"kernel": "spec.s4", "kind": "spec", "calls": 2,
+                 "steps": 8, "roofline_fraction": 0.31,
+                 "pct_of_step_time": 5.0},
+            ],
+            "headline_8b": {
+                "tok_s": 1391.1,
+                "kernels": [
+                    {"kernel": "prefill.b512.k8", "kind": "prefill",
+                     "calls": 4, "xla_flops_per_call": 1.0e12}]},
+        }})
+    rows = rr.kernel_report([p])
+    # Worst fraction first; the fraction-less prefill row sorts last.
+    assert [r["kernel"] for r in rows] == [
+        "spec.s4", "decode.d16.greedy", "prefill.b512.k8"]
+    assert rows[0]["rung"] == "headline"
+    assert rows[2]["rung"] == "headline_8b"
+    # The rung walker must not treat a kernel row as a rung itself.
+    rungs = {r["rung"] for r in rr.report([p])}
+    assert not any(r.startswith("kernels") for r in rungs)
+    # CLI: --kernels renders the second table.
+    assert rr.main([str(p), "--kernels"]) == 0
+    out = capsys.readouterr().out
+    assert "Per-kernel rows" in out and "spec.s4" in out
+
+
 def test_cli_exit_codes(tmp_path, capsys):
     good = _write(tmp_path, {"value": 1.0,
                              "extra": {"hbm_gbps": 5.0}}, "good.json")

@@ -77,6 +77,17 @@ def engine_events(engine: str, records: list[dict[str, Any]],
                 "args": {k: v for k, v in rec.items() if k != "t"},
             })
             continue
+        if kind == "profile":
+            # Profiler capture boundary (ISSUE 8): named instant so a
+            # flight timeline visually brackets the XLA capture window —
+            # the request_id carries the capture's trace directory.
+            events.append({
+                "ph": "i", "s": "g", "pid": pid, "tid": TID_LIFECYCLE,
+                "name": f"profile:{rec.get('phase', '?')}",
+                "cat": "profiler", "ts": us(rec["t"]),
+                "args": {k: v for k, v in rec.items() if k != "t"},
+            })
+            continue
         rid = rec.get("request_id", "")
         if kind == "admit":
             if rid:

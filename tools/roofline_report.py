@@ -14,6 +14,15 @@ you grep:
 
 Rows are discovered structurally (any dict owning a bandwidth or
 step-time field), so new bench rungs appear without editing this file.
+
+Since 0.21 (ISSUE 8) rungs may also carry per-kernel cost rows
+(``kernels`` lists recorded from the engine's kernel registry — one row
+per compiled executable variant with measured walls, cost_analysis
+FLOPs/bytes, and roofline fraction). Those flatten into a second table
+ranked worst-kernel-first, so "which kernel do I optimize next" is a
+reading:
+
+    python tools/roofline_report.py --kernels BENCH_SELF_r8_ladder.json
 """
 from __future__ import annotations
 
@@ -82,10 +91,49 @@ def report(paths: list[Path], peak_gbps: float = 0.0) -> list[dict]:
     return rows
 
 
-def format_table(rows: list[dict]) -> str:
+KERNEL_COLUMNS = ("calls", "steps", "step_ms", "pct_of_step_time",
+                  "hbm_bytes_per_step", "achieved_gbps",
+                  "roofline_fraction", "xla_flops_per_call",
+                  "xla_bytes_per_call")
+
+
+def kernel_report(paths: list[Path]) -> list[dict]:
+    """One row per (file, rung, kernel) from any rung carrying a
+    ``kernels`` list, ranked worst first: ascending roofline fraction
+    (kernels without one sort after measured ones), descending step-time
+    share as the tiebreak — the top row is the next kernel target."""
+    rows: list[dict] = []
+    for p in paths:
+        result = load_result(p)
+
+        def walk(node, path=""):
+            if not isinstance(node, dict):
+                return
+            kernels = node.get("kernels")
+            if isinstance(kernels, list):
+                for k in kernels:
+                    if isinstance(k, dict) and "kernel" in k:
+                        row = {"file": p.name, "rung": path or "headline",
+                               "kernel": k["kernel"]}
+                        for col in KERNEL_COLUMNS:
+                            if isinstance(k.get(col), (int, float)):
+                                row[col] = k[col]
+                        rows.append(row)
+            for key, val in node.items():
+                if key != "kernels":
+                    walk(val, f"{path}.{key}" if path else key)
+        walk(result.get("extra", {}))
+    rows.sort(key=lambda r: (r.get("roofline_fraction", float("inf")),
+                             -r.get("pct_of_step_time", 0.0)))
+    return rows
+
+
+def format_table(rows: list[dict], columns: tuple[str, ...] | None = None
+                 ) -> str:
     if not rows:
         return "(no rungs found)"
-    cols = ["file", "rung", *COLUMNS]
+    cols = list(columns) if columns is not None else ["file", "rung",
+                                                      *COLUMNS]
     cols = [c for c in cols if any(c in r for r in rows)]
     widths = {c: max(len(c), *(len(str(r.get(c, ""))) for r in rows))
               for c in cols}
@@ -107,13 +155,24 @@ def main(argv: list[str] | None = None) -> int:
                          "GB/s without one (v5e: 819)")
     ap.add_argument("--json", action="store_true",
                     help="emit rows as JSON instead of a table")
+    ap.add_argument("--kernels", action="store_true",
+                    help="also emit the per-kernel cost table (ISSUE 8), "
+                         "ranked worst roofline fraction first")
     args = ap.parse_args(argv)
     rows = report(args.files, peak_gbps=args.peak_gbps)
+    krows = kernel_report(args.files) if args.kernels else []
     if args.json:
-        print(json.dumps(rows, indent=2))
+        print(json.dumps({"rungs": rows, "kernels": krows} if args.kernels
+                         else rows, indent=2))
     else:
         print(format_table(rows))
-    return 0 if rows else 1
+        if args.kernels:
+            print()
+            print("Per-kernel rows (worst roofline fraction first):")
+            print(format_table(
+                krows, columns=("file", "rung", "kernel",
+                                *KERNEL_COLUMNS)))
+    return 0 if rows or krows else 1
 
 
 if __name__ == "__main__":
