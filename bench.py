@@ -573,6 +573,138 @@ def shared_prefix_rung(args) -> dict:
     return asyncio.run(run())
 
 
+def spec_ladder_rung(args) -> dict:
+    """ISSUE 10 acceptance rung: the speculative ladder — draft depth
+    0/1/3/7 × bf16/int8-KV on the PAGED layout (the headline config's
+    layout; int8+spec is the tentpole composition). Repetitive-text
+    regime, the one prompt-lookup drafting exists for, so depth is
+    exercised honestly: the batch-mean gates are disabled per arm and the
+    measured acceptance rate is reported instead. Each arm records tok/s
+    through the engine's real burst loop, accepted-tokens-per-step, the
+    acceptance ratio, and its registry worst_kernel() pick (the int8
+    rows are what PR 8's roofline named furthest from the HBM roof); the
+    int8 arm re-runs its mid depth across pages_per_block 1/2/4 — the
+    int8-aware DMA-blocking sweep. TTFT under load runs per spec depth
+    on the int8 arm unless --skip-ttft."""
+    import numpy as np
+    from llmapigateway_tpu.config.schemas import LocalEngineConfig
+    from llmapigateway_tpu.engine.engine import InferenceEngine
+    from llmapigateway_tpu.obs.device import worst_kernel
+
+    # Page geometry: keep the configured page when the context is big
+    # enough for a multi-page sweep, shrink for smoke shapes so ppb 2/4
+    # can still pack (a 1-page sequence can't block multiple pages).
+    page = min(args.page_size, max(16, args.seq // 4))
+    depths = (0, 1, 3, 7)
+
+    def one(kvq: str, k: int, ppb: int = 1, ttft: bool = False) -> dict:
+        cfg = LocalEngineConfig(
+            preset=args.preset, dtype="bfloat16",
+            max_batch_size=args.batch, max_seq_len=args.seq,
+            prefill_chunk=min(512, args.prompt_len),
+            decode_burst=args.burst, kv_layout="paged",
+            kv_page_size=page, kv_pages_per_block=ppb, kv_quant=kvq,
+            spec_draft_len=k,
+            # The ladder measures each depth, not the gate: batch-mean
+            # gates off (spec_mixed measures the gated path).
+            spec_min_tokens_per_step=0.0, spec_wall_gate=False,
+            hbm_peak_gbps=args.peak_gbps, prewarm_sampler_variants=False)
+        engine = InferenceEngine(cfg)
+        rng = np.random.default_rng(5)
+        base = rng.integers(0, engine.model_cfg.vocab_size, 16)
+        prompt = np.tile(base, args.prompt_len // 16 + 1)[
+            :args.prompt_len].astype(np.int32)
+        B, S = engine.B, engine.S
+        per_burst = (engine._spec_scan_len * (k + 1) if k
+                     else max(1, engine.decode_burst))
+        bursts = max(1, min(args.spec_bursts,
+                            (S - len(prompt) - 2) // per_burst - 1))
+        for slot in range(B):
+            if not engine.allocator.allocate(
+                    slot, len(prompt) + (bursts + 1) * per_burst + 1):
+                raise RuntimeError("spec-ladder paged pool too small")
+            engine._table_dirty = True
+            first, engine.cache = engine._exec_prefill(slot, 0, prompt)
+            engine.lengths[slot] = len(prompt)
+            engine.active[slot] = True
+            engine.last_token[slot] = int(base[len(prompt) % 16])
+            if k:
+                engine.hist[slot, :len(prompt)] = prompt
+        np.asarray(first)
+        engine._d_dirty = True
+        # Warm (compiles the scan program), then the timed loop.
+        if k:
+            engine._spec_burst(engine._spec_scan_len)
+        else:
+            engine._decode_burst(per_burst)
+        t0 = time.monotonic()
+        toks = 0
+        for _ in range(bursts):
+            if k:
+                rows = engine._spec_burst(engine._spec_scan_len)
+                toks += int(sum((r >= 0).sum() for r in rows))
+            else:
+                engine._decode_burst(per_burst)
+                toks += B * per_burst
+        dt = time.monotonic() - t0
+        rec = {"tok_s": round(toks / dt, 1), "draft_len": k}
+        if ppb > 1 or engine.kv_ppb > 1:
+            rec["pages_per_block"] = engine.kv_ppb
+        if k:
+            st = engine.stats()
+            prop, acc = st.get("spec_proposed", 0), st.get("spec_accepted", 0)
+            rec["acceptance"] = round(acc / prop, 3) if prop else None
+            rec["tokens_per_step"] = round(
+                engine._spec_tokens_out / max(1, engine._spec_steps_done), 2)
+        # Spend the PR 8 registry: this arm's furthest-below-the-roof
+        # kernel — on the int8 arms this ranks the int8 decode/spec
+        # variants the kernel work targets. The full table rides along so
+        # tools/roofline_report.py --kernels renders the ladder's spec
+        # rows (acceptance-adjusted) straight from the artifact.
+        engine.kernels.resolve_costs()
+        rec["kernels"] = engine.kernel_table()
+        wk = worst_kernel(rec["kernels"])
+        if wk:
+            rec["worst_kernel"] = wk
+        if ttft and not args.skip_ttft:
+            reset_slots(engine)
+            rec.update(measure_ttft_under_load(engine, args))
+        return rec
+
+    out = {"regime": "repetitive-text (prompt-lookup drafting's target); "
+                     "batch-mean gates off, paged layout",
+           "shape": f"bs={args.batch} ctx={args.prompt_len} "
+                    f"burst={args.burst} page={page}"}
+    for label, kvq in (("bf16", ""), ("int8", "int8")):
+        arm = {}
+        for k in depths:
+            arm[f"spec{k}"] = one(kvq, k, ttft=(label == "int8"))
+        base_tok = arm["spec0"]["tok_s"]
+        for k in depths[1:]:
+            arm[f"spec{k}"]["vs_spec_off"] = round(
+                arm[f"spec{k}"]["tok_s"] / max(1e-9, base_tok), 3)
+        out[label] = arm
+    # int8-aware pages_per_block sweep at the mid draft depth: the paged
+    # spec verify gathers pages for the deferred self-block, so DMA
+    # blocking interacts with drafting only on this arm.
+    ppb_sweep = {"1": out["int8"]["spec3"]["tok_s"]}
+    for ppb in (2, 4):
+        try:
+            r = one("int8", 3, ppb=ppb)
+            ppb_sweep[str(ppb)] = (r["tok_s"]
+                                   if r.get("pages_per_block") == ppb
+                                   else "fallback (can't pack)")
+        except Exception as e:           # noqa: BLE001 — sweep leg only
+            ppb_sweep[str(ppb)] = f"failed: {e!r}"
+    numeric = {p: v for p, v in ppb_sweep.items() if isinstance(v, float)}
+    if numeric:
+        best = max(numeric, key=numeric.get)
+        ppb_sweep["best_pages_per_block"] = int(best)
+        ppb_sweep["best_tok_s"] = numeric[best]
+    out["int8"]["ppb_sweep"] = ppb_sweep
+    return out
+
+
 def scheduler_throughput(engine, args, n_tokens: int = 120) -> float:
     """Steady-state tok/s through the REAL scheduler loop (admission,
     bursts, adaptive gates) with non-repetitive prompts: one warm round
@@ -931,6 +1063,11 @@ def main() -> None:
     ap.add_argument("--spec-draft", type=int, default=3,
                     help="speculative rung draft length (0 disables)")
     ap.add_argument("--spec-bursts", type=int, default=12)
+    ap.add_argument("--spec-ladder", type=int, default=1,
+                    help="speculative ladder rung: draft 0/1/3/7 x "
+                         "bf16/int8-KV on the paged layout, acceptance + "
+                         "tok/s + TTFT per arm, int8 ppb 1/2/4 sweep "
+                         "(0 disables; publishes BENCH_SPEC_r10)")
     ap.add_argument("--spec-mixed", type=int, default=1,
                     help="mixed-traffic spec rung: gated-spec vs normal on "
                          "random prompts through the scheduler (0 disables)")
@@ -1705,6 +1842,22 @@ def main() -> None:
         except Exception as e:
             errors.append(f"spec_mixed: {e!r}")
             note(f"FAILED spec-mixed phase: {e!r}")
+
+    # -- phase 4h2: speculative ladder (ISSUE 10) ----------------------------
+    # Draft depth 0/1/3/7 × bf16/int8-KV on the paged layout — the
+    # tentpole composition (int8 + spec) measured end to end, with the
+    # int8 arm's pages_per_block sweep and per-arm worst_kernel() picks.
+    if args.spec_draft and args.spec_ladder and not over_budget("spec_ladder"):
+        try:
+            extra["spec_ladder"] = spec_ladder_rung(args)
+            i8 = extra["spec_ladder"]["int8"]
+            note(f"spec ladder (int8): "
+                 + ", ".join(
+                     f"k={k} {i8[f'spec{k}']['tok_s']} tok/s"
+                     for k in (0, 1, 3, 7)))
+        except Exception as e:
+            errors.append(f"spec_ladder: {e!r}")
+            note(f"FAILED spec-ladder phase: {e!r}")
 
     # -- phase 4i: flight-recorder overhead A/B (ISSUE 7) --------------------
     if args.flight_ab and not over_budget("flight_ab"):

@@ -115,8 +115,11 @@ class LocalEngineConfig(BaseModel):
     # layouts and composes with seq/pipe sharding (the verify forward's
     # S-reductions partition under GSPMD / run through the staged
     # block) AND with multi-host serving (OP_SPEC command stream,
-    # per-process hist mirrors). Not with kv_quant (exact-greedy
-    # guarantee).
+    # per-process hist mirrors) AND with kv_quant='int8' (the verify
+    # self-block is mixed-precision: off-diagonal drafts go through the
+    # same quantize→dequantize plain decode reads, preserving the
+    # exact-greedy guarantee; only seq-sharded PAGED + int8 + spec is
+    # rejected at build).
     spec_draft_len: int = 0
     # Adaptive drafting gate: a speculative step is a T=k+1 verify forward
     # (~1.2-1.3x a T=1 step's device time), so drafting only pays while
@@ -133,6 +136,19 @@ class LocalEngineConfig(BaseModel):
     # chance to establish their rate.
     spec_min_tokens_per_step: float = 1.2
     spec_probe_interval: int = 25
+    # PER-SLOT adaptive drafting: suspend drafting on any slot whose
+    # acceptance EMA, expressed as an acceptance RATIO ((ema_tokens/step
+    # - 1) / k, i.e. the fraction of proposed drafts accepted), falls
+    # below this floor. A suspended slot's drafts are masked on device
+    # (deterministic 1 token/step), its EMA freezes, and it stops
+    # dragging the batch-mean gate above; when EVERY active slot is
+    # suspended the scheduler skips spec bursts entirely (full-width
+    # normal decode). Suspended slots re-probe together every
+    # `spec_probe_interval` spec rounds: one 1-step burst with all slots
+    # drafting re-measures, and a slot whose fresh ratio clears the
+    # floor resumes. 0 disables per-slot suspension (batch-level gates
+    # above still apply).
+    spec_acceptance_floor: float = 0.0
     # Wall-clock gate term: also close the gate while the MEASURED spec
     # ms-per-emitted-token (EMA over full spec bursts) exceeds the normal
     # path's. Acceptance tokens/step alone can hold a net-loss gate open

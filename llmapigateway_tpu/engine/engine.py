@@ -358,13 +358,35 @@ class InferenceEngine:
             # AND with pipeline sharding (the staged block tree-maps its
             # batch slicing over {q, s} cache leaves — parallel/
             # pipeline.py, closing VERDICT r3 item 7).
-            if engine_cfg.spec_draft_len:
+            # Speculative decoding composes since the verify self-block
+            # went mixed-precision (models/llama.py dense_verify_attention
+            # + the paged deferred verify): drafted tokens at u < t go
+            # through the same quantize→dequantize the insert path
+            # applies, the diagonal stays full precision like the decode
+            # self-column — so greedy output with spec on is exactly the
+            # spec-off sequence. Two combos remain unimplemented, both
+            # because their verify rides the insert-then-attend chunk
+            # path (no ``.verify`` provider), which reads even the draft
+            # self token quantized: the seq-sharded PAGED engine, and any
+            # pipeline-sharded engine (parallel/pipeline.py stage blocks
+            # verify as a chunk by design).
+            if (engine_cfg.spec_draft_len and self.paged
+                    and self.seq_n > 1):
                 raise ValueError(
-                    "kv_quant='int8' does not compose with speculative "
-                    "decoding: the verify forward sees draft tokens at "
-                    "full precision (self-block) while plain decode reads "
-                    "them quantized from the cache, so the output would "
-                    "no longer be exactly the greedy sequence")
+                    "kv_quant='int8' + spec_draft_len + seq-sharded "
+                    "paged cache is not supported: the seq-paged verify "
+                    "rides the chunk path, which reads the draft self "
+                    "token quantized (breaking exact-greedy parity). "
+                    "Use kv_layout='contiguous' with seq sharding, or "
+                    "drop seq sharding for the paged layout")
+            if engine_cfg.spec_draft_len and self.pipe_n > 1:
+                raise ValueError(
+                    "kv_quant='int8' + spec_draft_len + pipeline "
+                    "sharding is not supported: the staged block "
+                    "verifies drafts on the chunk path, which reads the "
+                    "draft self token quantized (breaking exact-greedy "
+                    "parity). Drop pipe sharding or kv_quant for "
+                    "speculative runs")
 
         # Sliding-window attention (mistral family): the windowed dense
         # paths, the windowed flash kernels, AND the windowed paged
@@ -772,6 +794,25 @@ class InferenceEngine:
                 1, self.cfg.spec_probe_interval)
             self._spec_ema = np.full((self.B,), np.nan)
             self._spec_probe_ctr = 0
+            # PER-SLOT adaptive drafting (config.spec_acceptance_floor):
+            # drafting suspends on a slot whose EMA-derived acceptance
+            # ratio ((ema - 1) / k) falls below the floor — its drafts
+            # are masked on device (deterministic 1 token/step), its EMA
+            # freezes at the suspended value, and the batch-mean gate
+            # above excludes it. Suspended slots re-probe together every
+            # spec_probe_interval spec rounds (the probe bit rides the
+            # OP_SPEC command in multihost so every process masks
+            # identically). Per-slot proposed/accepted counters feed the
+            # /metrics gauges and stats(); lifetime totals survive slot
+            # release.
+            self.spec_floor = min(1.0, max(
+                0.0, self.cfg.spec_acceptance_floor))
+            self._spec_suspended = np.zeros((self.B,), bool)
+            self._spec_suspend_probe_ctr = 0
+            self._spec_slot_proposed = np.zeros((self.B,), np.int64)
+            self._spec_slot_accepted = np.zeros((self.B,), np.int64)
+            self._spec_proposed_total = 0
+            self._spec_accepted_total = 0
             # Wall-clock gate term: EMA of measured ms per emitted token
             # across full spec bursts. Acceptance alone can lie — a
             # random-weight repetition loop accepts 2+ tokens/step while
@@ -1047,11 +1088,17 @@ class InferenceEngine:
                                       active=active, attention_fn=attn)
         else:
             def call_forward(params, cache, table, tokens, lengths,
-                             active=None, prefill=False):
+                             active=None, prefill=False, spec=False):
+                # `spec` builds the dedicated verify-capable provider:
+                # T = k+1 then routes through the deferred paged verify
+                # (stale-pool gather + mixed-precision self-block) instead
+                # of the chunk path — required for int8 greedy parity and
+                # skips the per-layer pool scatters either way.
                 attn = make_paged_attention_fn(table, max_seq=S, impl=impl,
                                                mesh=mesh,
                                                window=c.sliding_window,
-                                               pages_per_block=self.kv_ppb)
+                                               pages_per_block=self.kv_ppb,
+                                               spec=spec)
                 return family_forward(params, c, tokens, lengths, cache,
                                       active=active, attention_fn=attn)
 
@@ -1127,8 +1174,14 @@ class InferenceEngine:
 
             def make_fwd(tbl):
                 def fwd(params, c_, tokens, lengths, cache, active=None):
+                    # Only the single-host paged path has the dedicated
+                    # verify provider; the seq- and pipe-sharded
+                    # call_forwards verify on their chunk paths (exact
+                    # for bf16 KV; int8 combos are rejected at build).
+                    kw = ({"spec": True}
+                          if self.seq_n == 1 and self.pipe_n == 1 else {})
                     return call_forward(params, cache, tbl, tokens,
-                                        lengths, active=active)
+                                        lengths, active=active, **kw)
                 return fwd
 
             self._spec_scan_len = max(
@@ -1139,9 +1192,9 @@ class InferenceEngine:
 
             @partial(jax.jit, donate_argnums=(1,))
             def spec_step1(params, cache, table, hist, tokens, lengths,
-                           active):
+                           active, draft_ok):
                 return make_spec_step(make_fwd(table), c, self.spec_k)(
-                    params, cache, hist, tokens, lengths, active)
+                    params, cache, hist, tokens, lengths, active, draft_ok)
             self._spec_step = spec_step1
 
     def _warm_decode_variants(self) -> None:
@@ -1402,6 +1455,7 @@ class InferenceEngine:
         clamps0 = self._busy_clamps
         n_chunks = 0                  # compiled prefill dispatches this step
         n_tok = 0                     # tokens emitted downstream this step
+        spec_acc_n = 0                # accepted draft tokens landed this step
         # 1. Admit into free slots (dropping requests whose client is gone).
         #    Paged layout: the FIFO head also needs its full page reservation
         #    (engine/paged.py policy) — if pages are short it waits at the
@@ -1473,8 +1527,13 @@ class InferenceEngine:
             if self.spec_k:
                 # New text in this slot: acceptance starts unmeasured.
                 # (Reset at ADMISSION, not release, so stats keep the last
-                # measured rate while the engine drains/idles.)
+                # measured rate while the engine drains/idles.) The
+                # per-slot suspension lifts with it — the new request's
+                # text regime owes nothing to its predecessor's.
                 self._spec_ema[req.slot] = np.nan
+                self._spec_suspended[req.slot] = False
+                self._spec_slot_proposed[req.slot] = 0
+                self._spec_slot_accepted[req.slot] = 0
             if self.paged:
                 self.allocator.allocate(req.slot, total,
                                         ring_pages=self._swa_ring_pages,
@@ -1645,7 +1704,17 @@ class InferenceEngine:
                 # against a different failure mode.
                 below = False
                 if self.spec_min_tps > 0:
-                    ema = self._spec_ema[[r.slot for r in decoding]]
+                    slots = [r.slot for r in decoding]
+                    if self.spec_floor > 0:
+                        # Per-slot suspension already benches poor slots —
+                        # their frozen EMAs must not drag the BATCH mean
+                        # below the threshold and close the gate on the
+                        # slots that are still profiting. (All-suspended
+                        # batches skip the burst below regardless of what
+                        # the mean says.)
+                        slots = [s for s in slots
+                                 if not self._spec_suspended[s]] or slots
+                    ema = self._spec_ema[slots]
                     if not np.all(np.isnan(ema)):
                         mean_tps = float(np.mean(np.where(
                             np.isnan(ema), self.spec_k + 1, ema)))
@@ -1673,6 +1742,27 @@ class InferenceEngine:
                                 self._spec_wall_age = 0
                                 self._spec_ms_per_tok = None
                     else:
+                        spec_now = False
+            if spec_now and self.spec_floor > 0 and not spec_probe:
+                # Per-slot adaptive drafting (spec_acceptance_floor):
+                # suspended slots ride along in the k+1-wide verify at a
+                # deterministic 1 token/step, so when EVERY decoding slot
+                # is suspended the burst is pure overhead — decode
+                # normally instead, and every spec_probe_interval such
+                # rounds run ONE probe burst with the mask lifted so
+                # suspended slots get re-measured (text regimes change;
+                # a permanent bench would strand them). A mixed batch
+                # keeps bursting (drafting slots still profit) and the
+                # same cadence lifts the mask for its benched slots.
+                susp = sum(bool(self._spec_suspended[r.slot])
+                           for r in decoding)
+                if susp:
+                    self._spec_suspend_probe_ctr += 1
+                    if (self._spec_suspend_probe_ctr
+                            >= self.spec_probe_interval):
+                        self._spec_suspend_probe_ctr = 0
+                        spec_probe = True        # 1-step, mask lifted
+                    elif susp == len(decoding):
                         spec_now = False
             # While a spec burst is in flight (lag-one), the host lengths
             # lag dispatch by a data-dependent amount — cap against the
@@ -1703,8 +1793,10 @@ class InferenceEngine:
                     self._swa_rotate(decoding, inflight, max(1, burst) * kp1)
                 burst = max(1, burst)
                 t_dec0 = fl.clock() if fl is not None else 0.0
+                spec_acc0 = self._spec_accepted_total
                 step_tokens = await asyncio.to_thread(
-                    self._spec_burst, burst)
+                    self._spec_burst, burst, spec_probe)
+                spec_acc_n = self._spec_accepted_total - spec_acc0
             else:
                 burst = self._burst_depth(busy)
                 # Never burst past any slot's cache capacity or token
@@ -1777,6 +1869,7 @@ class InferenceEngine:
                 _fl.STEP, flag=flag, depth=depth, tokens=n_tok,
                 chunks=n_chunks,
                 dur_ms=1000.0 * (fl.clock() - t_step0),
+                spec_acc=spec_acc_n,
                 val=dec_wall_ms if decoding else 0.0,
                 active=len(self._running),
                 free_slots=len(self._free_slots),
@@ -1952,6 +2045,18 @@ class InferenceEngine:
                             wall_ms=1000.0 * (time.monotonic() - t0))
         return first, cache
 
+    def _kernel_variant(self, **base) -> dict:
+        """Registry variant dict for a decode/spec kernel: the caller's
+        keys plus the engine's KV identity (quantization, layout, DMA
+        blocking) — so the roofline table's worst_kernel() ranking can be
+        filtered to e.g. the int8 decode variants (ISSUE 10's kernel-work
+        driver) instead of guessing from the engine config."""
+        base["kv"] = self.kv_quant or "bf16"
+        base["layout"] = "paged" if self.paged else "contiguous"
+        if self.paged and self.kv_ppb > 1:
+            base["ppb"] = self.kv_ppb
+        return base
+
     def _exec_decode(self, n_steps: int, state: dict) -> list[np.ndarray]:
         """Run a burst from broadcast-packed host state (multihost path) —
         identical on coordinator and followers."""
@@ -2024,6 +2129,18 @@ class InferenceEngine:
             return
         if pos == 0:
             self.hist[slot, :] = 0
+            # Per-slot adaptive-drafting state resets HERE (not only at
+            # coordinator admission): the suspension mirror now feeds
+            # DEVICE data (the draft_ok mask), so it must evolve
+            # bit-identically on every multihost process — and followers
+            # only observe an admission through its first prefill chunk.
+            # (Warm admissions skip pos==0, but the prefix cache is
+            # single-host-only and the coordinator also resets at
+            # admission.)
+            self._spec_ema[slot] = np.nan
+            self._spec_suspended[slot] = False
+            self._spec_slot_proposed[slot] = 0
+            self._spec_slot_accepted[slot] = 0
         self.hist[slot, pos:pos + len(chunk)] = chunk
 
     def _follow_prefill(self, slot: int, pos: int, chunk: np.ndarray,
@@ -2063,19 +2180,27 @@ class InferenceEngine:
         if self.spec_k:
             self._d_hist_fresh = False
 
-    def _follow_spec(self, n_steps: int, reupload: bool, state: dict,
+    def _follow_spec(self, n_steps: int, flags: int, state: dict,
                      table: np.ndarray | None = None) -> None:
         """Replay one speculative burst: sync host mirrors from the
         command state, execute the identical program (rebuilding device
         mirrors from the local hist on a reupload), and walk the fetched
         emitted matrix so lengths/last_token/hist advance exactly as on
-        the coordinator."""
+        the coordinator. ``flags`` packs bit 0 = reupload, bit 1 = probe
+        (per-slot suspension lifted for this burst); the drafting mask
+        itself is derived locally — the suspension mirror evolves only
+        inside _spec_walk, identically on every process."""
+        reupload = bool(flags & 1)
+        probe = bool(flags >> 1 & 1)
         self._apply_table(table)
         self.lengths[:] = state["lengths"]
         self.active[:] = state["active"]
         self.last_token[:] = state["last_token"]
-        host = self._exec_spec(n_steps, state if reupload else None)
-        self._spec_walk(host, self.active.copy(), self.active.copy())
+        d_ok = self._spec_draft_ok(probe)
+        host = self._exec_spec(n_steps, state if reupload else None,
+                               draft_ok=d_ok)
+        self._spec_walk(host, self.active.copy(), self.active.copy(),
+                        drafting=d_ok)
 
     def run_follower(self) -> None:
         """Blocking replay loop for follower processes (process_index > 0)
@@ -2084,7 +2209,19 @@ class InferenceEngine:
         self._bridge.follow(self._follow_prefill, self._follow_decode,
                             self._follow_spec if self.spec_k else None)
 
-    def _spec_burst(self, n_steps: int) -> list[np.ndarray]:
+    def _spec_draft_ok(self, probe: bool) -> np.ndarray:
+        """The per-slot drafting mask for one spec burst: every slot
+        drafts unless per-slot suspension is on (spec_acceptance_floor)
+        and the slot is suspended; a PROBE burst re-enables every slot
+        for one re-measure. Identical on every multihost process: the
+        suspension mirror only changes inside _spec_walk (shared), and
+        the probe bit rides the OP_SPEC command."""
+        if self.spec_floor <= 0 or probe:
+            return np.ones((self.B,), bool)
+        return ~self._spec_suspended
+
+    def _spec_burst(self, n_steps: int,
+                    probe: bool = False) -> list[np.ndarray]:
         """Run `n_steps` speculative draft+verify steps (engine/
         speculative.py). Full-size bursts run LAG-ONE pipelined like the
         normal path: this call dispatches burst N (device-side hist/token/
@@ -2106,7 +2243,11 @@ class InferenceEngine:
             # mirrors stay bit-identical. The hist never rides the wire:
             # every process maintains its own mirror (see
             # _spec_hist_chunk / _spec_walk); a reupload rebuilds the
-            # device hist from it on both sides.
+            # device hist from it on both sides. The per-slot drafting
+            # mask is derived from the suspension mirror (identical on
+            # every process — it evolves only through _spec_walk); only
+            # the PROBE bit rides the wire, because the probe cadence
+            # lives in the coordinator's scheduler.
             reupload = self._d_dirty or not self._d_hist_fresh
             self._rng, key = jax.random.split(self._rng)
             packed = self._bridge.pack_decode_state(
@@ -2115,13 +2256,16 @@ class InferenceEngine:
                 self.samp_presence, self.samp_frequency,
                 np.asarray(jax.random.key_data(key)))
             self._bridge.publish_spec(n_steps, reupload, packed,
-                                      table=self._table_to_publish())
+                                      table=self._table_to_publish(),
+                                      probe=probe)
             state = self._bridge.unpack_decode_state(packed)
-            host = self._exec_spec(n_steps, state if reupload else None)
+            d_ok = self._spec_draft_ok(probe)
+            host = self._exec_spec(n_steps, state if reupload else None,
+                                   draft_ok=d_ok)
             self._d_dirty = False
             self._d_hist_fresh = True
             return self._spec_walk(host, self.active.copy(),
-                                   self.active.copy())
+                                   self.active.copy(), drafting=d_ok)
         # A mixed-mode engine may have a normal burst in flight (the batch
         # just turned all-greedy): land it first so mirrors are exact.
         pre = self._flush_pending()
@@ -2133,15 +2277,18 @@ class InferenceEngine:
             self._d_dirty = False
             self._d_hist_fresh = True
 
+        d_ok = self._spec_draft_ok(probe)
+        d_ok_dev = jax.device_put(d_ok, NamedSharding(self.mesh, P()))
         table = (self._device_table(),) if self.paged else ()
         if n_steps == self._spec_scan_len:
             t0 = time.monotonic()
             args = (self.params, self.cache, *table, self._d_hist,
-                    self._d_tokens, self._d_lengths, self._d_active)
+                    self._d_tokens, self._d_lengths, self._d_active,
+                    d_ok_dev)
             kname = f"spec.s{n_steps}"
             if self.kernels.needs(kname):
                 self.kernels.register(
-                    kname, "spec", variant={"depth": n_steps},
+                    kname, "spec", variant=self._kernel_variant(depth=n_steps),
                     cost_fn=_kernel_cost_fn(self._spec_scan, args))
             with _device_phase("spec.verify",
                                annotate=self.profile_annotations):
@@ -2150,7 +2297,7 @@ class InferenceEngine:
                 _start_host_copy(emitted)
             prev, self._spec_pending = self._spec_pending, (
                 emitted, n_steps, self.active.copy(),
-                self._slot_epoch.copy())
+                self._slot_epoch.copy(), d_ok)
             before = self._spec_tokens_out
             out = pre + self._flush_spec_entry(prev)
             steady = prev is not None and prev[1] == n_steps
@@ -2180,10 +2327,11 @@ class InferenceEngine:
         with _device_phase("spec.verify", annotate=self.profile_annotations):
             for _ in range(n_steps):
                 args = (self.params, self.cache, *table, self._d_hist,
-                        self._d_tokens, self._d_lengths, self._d_active)
+                        self._d_tokens, self._d_lengths, self._d_active,
+                        d_ok_dev)
                 if self.kernels.needs(kname):
                     self.kernels.register(
-                        kname, "spec", variant={"depth": 1},
+                        kname, "spec", variant=self._kernel_variant(depth=1),
                         cost_fn=_kernel_cost_fn(self._spec_step, args))
                 self._d_tokens, self._d_lengths, self.cache, self._d_hist, \
                     em, _ = self._spec_step(*args)
@@ -2192,7 +2340,8 @@ class InferenceEngine:
             host = np.stack([np.asarray(e) for e in outs])
         self.kernels.record(kname, steps=n_steps,
                             wall_ms=1000.0 * (time.monotonic() - t0))
-        return pre + self._spec_walk(host, self.active, self.active.copy())
+        return pre + self._spec_walk(host, self.active, self.active.copy(),
+                                     drafting=d_ok)
 
     def _spec_upload(self, state: dict | None = None) -> None:
         """Rebuild EVERY device mirror for the speculative chain — the ONE
@@ -2226,29 +2375,36 @@ class InferenceEngine:
             frequency_penalty=jax.device_put(np.asarray(
                 s.get("frequency", self.samp_frequency), np.float32), rep))
 
-    def _exec_spec(self, n_steps: int, state: dict | None) -> np.ndarray:
+    def _exec_spec(self, n_steps: int, state: dict | None,
+                   draft_ok: np.ndarray | None = None) -> np.ndarray:
         """The one compiled-speculative-burst call — identical on
         coordinator and followers. ``state`` non-None = reupload: rebuild
         every device mirror (incl. the hist, from the LOCAL bit-identical
         host mirror) from the broadcast slot state; None = chain the
-        device arrays from the previous burst. Returns the fetched
-        emitted matrix [n_steps, B, k+1] (synchronous — multihost has no
-        lag-one)."""
+        device arrays from the previous burst. ``draft_ok`` is the
+        per-slot drafting mask (None = every slot drafts). Returns the
+        fetched emitted matrix [n_steps, B, k+1] (synchronous — multihost
+        has no lag-one)."""
         if state is not None:
             self._spec_upload(state)
+        if draft_ok is None:
+            draft_ok = np.ones((self.B,), bool)
+        d_ok_dev = jax.device_put(draft_ok, NamedSharding(self.mesh, P()))
         table = (self._device_table(),) if self.paged else ()
         if n_steps == self._spec_scan_len:
             emitted, self.cache, self._d_hist, self._d_tokens, \
                 self._d_lengths = self._spec_scan(
                     self.params, self.cache, *table, self._d_hist,
-                    self._d_tokens, self._d_lengths, self._d_active)
+                    self._d_tokens, self._d_lengths, self._d_active,
+                    d_ok_dev)
             return np.asarray(emitted)
         outs = []
         for _ in range(n_steps):
             self._d_tokens, self._d_lengths, self.cache, self._d_hist, \
                 em, _ = self._spec_step(
                     self.params, self.cache, *table, self._d_hist,
-                    self._d_tokens, self._d_lengths, self._d_active)
+                    self._d_tokens, self._d_lengths, self._d_active,
+                    d_ok_dev)
             outs.append(em)
         return np.stack([np.asarray(e) for e in outs])
 
@@ -2351,37 +2507,56 @@ class InferenceEngine:
         dispatch are excluded by the epoch guard and their rows masked."""
         if entry is None:
             return []
-        emitted, _, active_snap, epoch_snap = entry
+        emitted, _, active_snap, epoch_snap, drafting = entry
         host = np.asarray(emitted)                       # [n, B, k+1]
         live = active_snap & (epoch_snap == self._slot_epoch)
-        return self._spec_walk(host, active_snap, live)
+        return self._spec_walk(host, active_snap, live, drafting=drafting)
 
     def _spec_walk(self, host: np.ndarray, active_snap: np.ndarray,
-                   live: np.ndarray) -> list[np.ndarray]:
+                   live: np.ndarray,
+                   drafting: np.ndarray | None = None) -> list[np.ndarray]:
         """Exact host-mirror walk (lengths / last_token / history): each
         step's valid inputs are [current token] + accepted drafts, i.e.
         [cur] + emitted[:count-1]; the step's last emitted token becomes
-        the next input. Returns emission rows (dead slots masked -1)."""
+        the next input. Returns emission rows (dead slots masked -1).
+
+        ``drafting`` [B] bool is the burst's per-slot drafting mask: a
+        suspended slot emitted exactly 1 token/step by construction (its
+        drafts were masked to -1), so its rows carry NO acceptance signal
+        — the EMA is frozen and proposal counters skip it. The suspension
+        mirror itself is re-derived here (ratio = (ema-1)/k against
+        spec_acceptance_floor), which keeps it bit-identical across
+        multihost processes: every process runs the same walk."""
         kp1 = self.spec_k + 1
+        if drafting is None:
+            drafting = np.ones((self.B,), bool)
         for slot in np.nonzero(live)[0]:
             pos = int(self.lengths[slot])
             cur = int(self.last_token[slot])
             for i in range(host.shape[0]):
                 toks = host[i, slot]
                 count = int((toks >= 0).sum())
-                # Acceptance EMA feeding the adaptive drafting gate.
-                # Asymmetric: an unmeasured slot decays from the optimistic
-                # k+1 prior — prompt-lookup needs ~10 steps for a fresh
-                # generation to enter its repetitive cycle (measured on the
-                # tiny-test workload), so a slow fall grants that grace —
-                # while a high-acceptance step rises fast (a=0.5), letting
-                # a single 1-step probe re-open a closed gate the moment
-                # text turns repetitive.
-                prev = self._spec_ema[slot]
-                if np.isnan(prev):
-                    prev = float(self.spec_k + 1)
-                a = 0.5 if count > prev else 0.2
-                self._spec_ema[slot] = (1 - a) * prev + a * count
+                if drafting[slot]:
+                    # Acceptance EMA feeding the adaptive drafting gates.
+                    # Asymmetric: an unmeasured slot decays from the
+                    # optimistic k+1 prior — prompt-lookup needs ~10 steps
+                    # for a fresh generation to enter its repetitive cycle
+                    # (measured on the tiny-test workload), so a slow fall
+                    # grants that grace — while a high-acceptance step
+                    # rises fast (a=0.5), letting a single 1-step probe
+                    # re-open a closed gate the moment text turns
+                    # repetitive. Suspended slots contribute no samples:
+                    # their 1 token/step is an artifact of the mask, not a
+                    # measurement.
+                    prev = self._spec_ema[slot]
+                    if np.isnan(prev):
+                        prev = float(self.spec_k + 1)
+                    a = 0.5 if count > prev else 0.2
+                    self._spec_ema[slot] = (1 - a) * prev + a * count
+                    self._spec_slot_proposed[slot] += self.spec_k
+                    self._spec_slot_accepted[slot] += max(0, count - 1)
+                    self._spec_proposed_total += self.spec_k
+                    self._spec_accepted_total += max(0, count - 1)
                 if count == 0:
                     continue
                 if pos < self.S:
@@ -2393,6 +2568,19 @@ class InferenceEngine:
                 pos += count
             self.lengths[slot] = pos
             self.last_token[slot] = cur
+        if self.spec_floor > 0:
+            # Re-derive the per-slot suspension mirror from the freshly
+            # updated EMAs. ratio = (ema - 1) / k maps the EMA (1..k+1
+            # tokens/step) onto the acceptance fraction [0, 1]; a slot
+            # below the floor stops drafting until a probe burst (which
+            # runs with the mask lifted) measures it back above. NaN =
+            # never measured = keep drafting (the optimistic prior).
+            for slot in np.nonzero(live & drafting)[0]:
+                ema = self._spec_ema[slot]
+                if np.isnan(ema):
+                    continue
+                ratio = (ema - 1.0) / max(1, self.spec_k)
+                self._spec_suspended[slot] = bool(ratio < self.spec_floor)
         if not live.all():
             host = host.copy()
             host[:, ~live] = -1
@@ -2625,7 +2813,7 @@ class InferenceEngine:
             if self.kernels.needs(kname):
                 self.kernels.register(
                     kname, "decode",
-                    variant={"depth": n_steps, "greedy": greedy},
+                    variant=self._kernel_variant(depth=n_steps, greedy=greedy),
                     cost_fn=_kernel_cost_fn(scan_fn, args))
             with _device_phase("decode", annotate=self.profile_annotations):
                 toks, self._d_tokens, self._d_lengths, self._d_counts, \
@@ -2680,7 +2868,7 @@ class InferenceEngine:
                 if self.kernels.needs(kname):
                     self.kernels.register(
                         kname, "decode",
-                        variant={"depth": 1, "greedy": greedy},
+                        variant=self._kernel_variant(depth=1, greedy=greedy),
                         cost_fn=_kernel_cost_fn(step_fn, args))
                 self._d_tokens, self._d_lengths, self._d_counts, \
                     self.cache = step_fn(*args)
@@ -3040,14 +3228,32 @@ class InferenceEngine:
             out["spec_draft_len"] = self.spec_k
             # Speculative acceptance telemetry (ROADMAP item 3 stub):
             # drafted-vs-accepted token totals, bridged to the
-            # gateway_engine_spec_* /metrics series. Each spec step
-            # drafts k tokens per active slot and emits accepted+1.
-            out["spec_proposed"] = self._spec_steps_done * self.spec_k
-            out["spec_accepted"] = max(
-                0, self._spec_tokens_out - self._spec_steps_done)
+            # gateway_engine_spec_* /metrics series. Counted explicitly
+            # per drafting slot in _spec_walk — a suspended slot
+            # (spec_acceptance_floor) proposes nothing, so steps*k would
+            # overcount the denominator and understate the true rate.
+            out["spec_proposed"] = self._spec_proposed_total
+            out["spec_accepted"] = self._spec_accepted_total
             if self._spec_steps_done:
                 out["spec_tokens_per_step"] = round(
                     self._spec_tokens_out / self._spec_steps_done, 2)
+            if self.spec_floor > 0:
+                # Per-slot adaptive drafting: the floor, which slots are
+                # currently benched, and each measured slot's EMA-derived
+                # acceptance ratio ((ema-1)/k — the quantity the floor
+                # compares against). Bridged to the per-slot
+                # gateway_engine_spec_slot_acceptance_ratio gauge and the
+                # gateway_engine_spec_suspended_slots_total count.
+                out["spec_acceptance_floor"] = self.spec_floor
+                out["spec_suspended_slots"] = int(
+                    self._spec_suspended.sum())
+                ratios = {}
+                for s in range(self.B):
+                    ema = self._spec_ema[s]
+                    if not np.isnan(ema):
+                        ratios[s] = round(
+                            (float(ema) - 1.0) / max(1, self.spec_k), 3)
+                out["spec_slot_acceptance"] = ratios
             if self.spec_min_tps > 0 or self._spec_wall_gate_on:
                 # Live view of the adaptive gate: mean measured acceptance
                 # (active slots when serving, else the last measured

@@ -71,18 +71,27 @@ def make_spec_step(model_forward, config, k: int):
     is the family forward already configured with the engine's attention
     implementation; T = k+1 routes through its chunk path.
 
-    Returns ``step(params, cache, hist, tokens, lengths, active) ->
-    (next_tokens, new_lengths, cache, hist, emitted, n_new)`` where
-    ``emitted`` is [B, k+1] int32 with -1 past each slot's accepted count
-    (emission-ready: the scheduler already skips negative tokens) and
-    ``n_new`` is [B] in [0, k+1] (0 for inactive slots).
+    Returns ``step(params, cache, hist, tokens, lengths, active,
+    draft_ok) -> (next_tokens, new_lengths, cache, hist, emitted,
+    n_new)`` where ``emitted`` is [B, k+1] int32 with -1 past each slot's
+    accepted count (emission-ready: the scheduler already skips negative
+    tokens) and ``n_new`` is [B] in [0, k+1] (0 for inactive slots).
+    ``draft_ok`` [B] bool is the per-slot adaptive drafting gate: a
+    suspended slot's drafts are masked to -1 — never a valid argmax, so
+    verification deterministically rejects them all and the slot advances
+    exactly 1 token/step, while the batch's drafting slots keep their
+    full k-token speculation. (The verify width stays k+1 — suspension
+    pays off via the scheduler, which skips spec bursts entirely when
+    every slot is suspended, and via the acceptance gate's batch mean,
+    which suspended slots no longer drag down.)
     """
     c = config
 
-    def step(params, cache, hist, tokens, lengths, active):
+    def step(params, cache, hist, tokens, lengths, active, draft_ok):
         B = tokens.shape[0]
         S = hist.shape[1]
         draft = draft_from_history(hist, tokens, lengths, k)        # [B, k]
+        draft = jnp.where(draft_ok[:, None], draft, -1)
         seq = jnp.concatenate([tokens[:, None], draft], axis=1)     # [B,k+1]
         logits, out = model_forward(params, c, seq, lengths, cache,
                                     active=active)
@@ -119,23 +128,26 @@ def make_spec_burst(model_forward, config, k: int, n_steps: int,
                     make_forward=None):
     """Fused scan over ``n_steps`` speculative steps (ONE dispatch).
 
-    Returns ``burst(params, cache, [table,] hist, tokens, lengths, active)
-    -> (emitted [n_steps, B, k+1], cache, hist, tokens, lengths)``;
-    lengths and the emitted counts are data-dependent, so the caller syncs
-    host mirrors from the fetched ``emitted`` (count = tokens >= 0 per
-    row). ``make_forward(table) -> model_forward`` supports the paged
-    layout, whose attention closes over the traced page table (the table
-    becomes an extra positional arg and ``model_forward`` is ignored).
+    Returns ``burst(params, cache, [table,] hist, tokens, lengths, active,
+    draft_ok) -> (emitted [n_steps, B, k+1], cache, hist, tokens,
+    lengths)``; lengths and the emitted counts are data-dependent, so the
+    caller syncs host mirrors from the fetched ``emitted`` (count =
+    tokens >= 0 per row). ``draft_ok`` [B] bool (the per-slot adaptive
+    drafting gate, see make_spec_step) is burst-invariant: suspension
+    decisions happen on the host between bursts. ``make_forward(table) ->
+    model_forward`` supports the paged layout, whose attention closes
+    over the traced page table (the table becomes an extra positional arg
+    and ``model_forward`` is ignored).
     """
     if make_forward is None:
         step = make_spec_step(model_forward, config, k)
 
         @partial(jax.jit, donate_argnums=(1,))
-        def burst(params, cache, hist, tokens, lengths, active):
+        def burst(params, cache, hist, tokens, lengths, active, draft_ok):
             def body(carry, _):
                 cache, hist, tokens, lengths = carry
                 nt, nl, cache, hist, emitted, _ = step(
-                    params, cache, hist, tokens, lengths, active)
+                    params, cache, hist, tokens, lengths, active, draft_ok)
                 return (cache, hist, nt, nl), emitted
             (cache, hist, tokens, lengths), emitted = jax.lax.scan(
                 body, (cache, hist, tokens, lengths), None, length=n_steps)
@@ -144,13 +156,14 @@ def make_spec_burst(model_forward, config, k: int, n_steps: int,
         return burst
 
     @partial(jax.jit, donate_argnums=(1,))
-    def paged_burst(params, cache, table, hist, tokens, lengths, active):
+    def paged_burst(params, cache, table, hist, tokens, lengths, active,
+                    draft_ok):
         step = make_spec_step(make_forward(table), config, k)
 
         def body(carry, _):
             cache, hist, tokens, lengths = carry
             nt, nl, cache, hist, emitted, _ = step(
-                params, cache, hist, tokens, lengths, active)
+                params, cache, hist, tokens, lengths, active, draft_ok)
             return (cache, hist, nt, nl), emitted
         (cache, hist, tokens, lengths), emitted = jax.lax.scan(
             body, (cache, hist, tokens, lengths), None, length=n_steps)

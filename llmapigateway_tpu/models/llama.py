@@ -402,6 +402,26 @@ def dense_verify_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
         scores = scores * ks[:, :, :, None, :]    # [B,KV,1,1,S]
     self_s = jnp.einsum("bkgtd,bkud->bkgtu", qg, kn,
                         preferred_element_type=jnp.float32) * scale
+    if ks is not None:
+        # Quantized cache: MIXED-PRECISION self-block. Plain decode sees a
+        # drafted token u two different ways — full precision in its own
+        # step's self-column (u == t), quantize→dequantize from the cache
+        # in every LATER step (u < t, inserted by insert_kv_stacked). For
+        # greedy parity with spec off, the verify block must reproduce
+        # that split exactly: off-diagonal entries use the SAME
+        # quantize_kv the insert path will apply to these k_new/v_new
+        # (bitwise-identical q and s), with the same op order as the
+        # stale path ((dot · scale) · s; probs · s before the PV dot,
+        # cast to the cache view dtype). The diagonal stays full
+        # precision, matching the decode self-column.
+        knq, kns = quantize_kv(k_new)             # [B,T,KV,Dh], [B,T,KV]
+        knq = knq.transpose(0, 2, 1, 3).astype(q.dtype)     # [B,KV,U,Dh]
+        kns = kns.transpose(0, 2, 1)                        # [B,KV,U]
+        self_sq = jnp.einsum("bkgtd,bkud->bkgtu", qg, knq,
+                             preferred_element_type=jnp.float32) * scale
+        self_sq = self_sq * kns[:, :, None, None, :]
+        diag = jnp.eye(T, dtype=bool)[None, None, None]   # [1,1,1,T,U]
+        self_s = jnp.where(diag, self_s, self_sq)
 
     visible = jnp.arange(S)[None, :] < lengths[:, None]            # [B, S]
     if window:
@@ -435,7 +455,22 @@ def dense_verify_attention(q: jax.Array, k_new: jax.Array, v_new: jax.Array,
         p = p * vs[:, :, :, None, :]              # [B,KV,1,1,S]
     out = jnp.einsum("bkgts,bksd->bkgtd", p.astype(lv.dtype), lv,
                      preferred_element_type=jnp.float32)
-    out = out + jnp.einsum("bkgtu,bkud->bkgtd", p_self, vn)
+    if vs is not None:
+        # Mixed-precision PV to match: off-diagonal drafted values go
+        # through the same qdq + dtype cast as the stale path; the
+        # diagonal uses the full-precision fp32 value like the decode
+        # self-column. Masking by multiply is exact (×1.0 / ×0.0).
+        vnq, vns = quantize_kv(v_new)             # [B,T,KV,Dh], [B,T,KV]
+        vnq = vnq.transpose(0, 2, 1, 3).astype(q.dtype)     # [B,KV,U,Dh]
+        vns = vns.transpose(0, 2, 1)                        # [B,KV,U]
+        diag_f = jnp.eye(T, dtype=jnp.float32)[None, None, None]
+        p_off = p_self * (1.0 - diag_f) * vns[:, :, None, None, :]
+        out = out + jnp.einsum("bkgtu,bkud->bkgtd",
+                               p_off.astype(vnq.dtype), vnq,
+                               preferred_element_type=jnp.float32)
+        out = out + jnp.einsum("bkgtu,bkud->bkgtd", p_self * diag_f, vn)
+    else:
+        out = out + jnp.einsum("bkgtu,bkud->bkgtd", p_self, vn)
     out = out / l[..., None]
     # [B,KV,G,T,Dh] → [B,T,H*Dh]
     out = out.transpose(0, 3, 1, 2, 4).reshape(B, T, H * Dh)

@@ -81,6 +81,9 @@ _DTYPE = np.dtype([
     ("val", np.float32),        # kind-specific: decode-burst wall ms
                                 # (STEP), queue-wait ms (ADMIT), pages
                                 # evicted (EVICT)
+    ("spec_acc", np.int32),     # SPEC steps: accepted draft tokens this
+                                # burst (tokens - spec_acc = what a plain
+                                # burst of the same depth would have made)
 ])
 
 FINISH_REASONS = ("stop", "length", "cancelled", "error")
@@ -130,7 +133,7 @@ class FlightRecorder:
                chunks: int = 0, active: int = 0, free_slots: int = 0,
                queued: int = 0, free_pages: int = -1,
                fitted_ms: float = math.nan, val: float = 0.0,
-               rid: str | None = None) -> int:
+               spec_acc: int = 0, rid: str | None = None) -> int:
         """Append one record; returns its sequence number. Scalar stores
         into preallocated storage only — no per-record allocation."""
         i = self._seq % self.capacity
@@ -150,6 +153,7 @@ class FlightRecorder:
         cols["free_pages"][i] = free_pages
         cols["fitted_ms"][i] = fitted_ms
         cols["val"][i] = val
+        cols["spec_acc"][i] = spec_acc
         self._rid[i] = rid
         seq = self._seq
         self._seq += 1
@@ -203,6 +207,10 @@ class FlightRecorder:
                 d["queued"] = int(row["queued"])
                 if row["free_pages"] >= 0:
                     d["free_pages"] = int(row["free_pages"])
+                if flag & F_SPEC:
+                    # Accepted draft tokens this burst: the speculation
+                    # win over a plain burst of the same depth.
+                    d["spec_accepted"] = int(row["spec_acc"])
                 dv = float(row["val"])
                 if dv:
                     d["decode_wall_ms"] = round(dv, 3)

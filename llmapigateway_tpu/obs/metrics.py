@@ -461,6 +461,18 @@ class GatewayMetrics:
         self.engine_spec_acceptance_ratio = r.gauge(
             "gateway_engine_spec_acceptance_ratio",
             "Accepted over proposed draft tokens (lifetime).", ("engine",))
+        # Per-slot adaptive drafting (spec_acceptance_floor): how many
+        # slots are currently benched, plus each measured slot's live
+        # EMA-derived acceptance ratio — the quantity the floor compares
+        # against ((ema - 1) / k, in [0, 1]).
+        self.engine_spec_suspended_slots = r.gauge(
+            "gateway_engine_spec_suspended_slots_total",
+            "Slots with drafting suspended by spec_acceptance_floor.",
+            ("engine",))
+        self.engine_spec_slot_acceptance_ratio = r.gauge(
+            "gateway_engine_spec_slot_acceptance_ratio",
+            "Per-slot EMA acceptance ratio ((ema-1)/k) feeding the "
+            "adaptive drafting floor.", ("engine", "slot"))
         # Flight recorder (ISSUE 7): ring position and wrap loss.
         self.engine_flight_ring_evicted_total = r.gauge(
             "gateway_engine_flight_ring_evicted_total",

@@ -97,9 +97,22 @@ def attend_block(q_ref, k_ref, v_ref, m_ref, l_ref, acc_ref, mask,
     physical pages in ONE ``(ppb, 1, page, Dh)`` block and attend them
     per-page (ops/paged_attention.py), so each call here stays the exact
     per-page update — only the DMA granularity grows."""
-    q = q_ref[0, 0].astype(jnp.float32)            # [rows, Dh]
-    k = k_ref[sub, 0].astype(jnp.float32)          # [BS, Dh] (bf16 or int8)
+    q = q_ref[0, 0]                                # [rows, Dh]
+    k = k_ref[sub, 0]                              # [BS, Dh] (bf16 or int8)
     v = v_ref[sub, 0].astype(jnp.float32)
+    if k.dtype == jnp.int8:
+        # int8-KV QK dot (the worst_kernel() pick on the int8 ladder —
+        # decode.d*.greedy sat at ~0.4 of the HBM roof): dequant is fused
+        # into the dot as a cast to q's NATIVE dtype. Every int8 value is
+        # exact in bf16 (8 mantissa bits ≥ the 7 magnitude bits of ±127),
+        # so scores are bit-identical to the old `.astype(float32)` pair —
+        # but the MXU now runs one native low-precision pass with fp32
+        # accumulation instead of the multi-pass fp32×fp32 matmul the
+        # explicit upcast forced.
+        k = k.astype(q.dtype)
+    else:
+        q = q.astype(jnp.float32)
+        k = k.astype(jnp.float32)
     scores = jax.lax.dot_general(
         q, k, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)        # [rows, BS]

@@ -142,44 +142,51 @@ def paged_insert_all(pool_k, pool_v,
                      k_news: jax.Array, v_news: jax.Array,
                      page_table: jax.Array, lengths: jax.Array,
                      active: jax.Array | None):
-    """Insert every layer's ONE new decode token into the page pool with a
-    single scatter (the paged half of the deferred-insert protocol —
+    """Insert every layer's new tokens into the page pool with a single
+    scatter (the paged half of the deferred-insert protocol —
     models/llama.py ``insert_kv_stacked`` is the dense twin).
 
     pool_k/v: [L, P, KV, page, Dh] (or the int8 ``{"q","s"}`` dict);
-    k_news/v_news: [L, B, 1, KV, Dh] (the layer scan's stacked ys, always
+    k_news/v_news: [L, B, T, KV, Dh] (the layer scan's stacked ys, always
     bf16/fp32 — quantization happens here at write time); lengths: [B] —
-    the token's logical position. Masked/overflow writes land on trash
+    the first token's logical position (token t lands at lengths + t:
+    T = 1 is the decode step, T = k+1 the speculative verify, whose
+    rejected tail lands in the undefined zone past the advanced lengths
+    exactly like the dense twin). Masked/overflow writes land on trash
     page 0 as usual.
     """
     quant = isinstance(pool_k, dict)
     page = (pool_k["q"] if quant else pool_k).shape[3]
     NP = page_table.shape[1]
+    L, B, T = k_news.shape[:3]
 
-    logical = jnp.clip(lengths // page, 0, NP - 1)                 # [B]
-    phys = jnp.take_along_axis(page_table, logical[:, None], axis=1)[:, 0]
-    ok = (lengths // page) < NP
+    pos = lengths[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]  # [B,T]
+    logical = jnp.clip(pos // page, 0, NP - 1)
+    phys = jnp.take_along_axis(page_table, logical, axis=1)           # [B,T]
+    ok = (pos // page) < NP
     if active is not None:
-        ok = ok & active
-    phys = jnp.where(ok, phys, 0)
-    off = lengths % page
+        ok = ok & active[:, None]
+    phys = jnp.where(ok, phys, 0).reshape(-1)                         # [B*T]
+    off = (pos % page).reshape(-1)
 
     # Advanced indices (phys, off) are separated by slices, so the indexed
-    # result is [B, L, KV(, Dh)] — the [L, B, ...] new tokens transpose to
-    # match. In-bounds by construction (see paged_insert_kv).
+    # result is [B*T, L, KV(, Dh)] — the [L, B, T, ...] new tokens
+    # transpose to match. In-bounds by construction (see paged_insert_kv).
     def scatter(pool, news):
-        new = news[:, :, 0].swapaxes(0, 1).astype(pool.dtype)
+        new = news.transpose(1, 2, 0, 3, 4).reshape(
+            B * T, L, *news.shape[3:]).astype(pool.dtype)
         return pool.at[:, phys, :, off].set(new, mode="promise_in_bounds")
 
     def scatter_s(pool, news):
         # Scale pool [L, P, KV, 1, page]: through the unit dim.
-        new = news[:, :, 0].swapaxes(0, 1).astype(pool.dtype)
+        new = news.transpose(1, 2, 0, 3).reshape(
+            B * T, L, news.shape[3]).astype(pool.dtype)
         return pool.at[:, phys, :, 0, off].set(new,
                                                mode="promise_in_bounds")
 
     if quant:
         from ..models.llama import quantize_kv
-        kq, ks = quantize_kv(k_news)      # [L,B,1,KV,Dh], [L,B,1,KV]
+        kq, ks = quantize_kv(k_news)      # [L,B,T,KV,Dh], [L,B,T,KV]
         vq, vs = quantize_kv(v_news)
         return (
             {"q": scatter(pool_k["q"], kq),
@@ -587,7 +594,8 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
                             block_t: int | None = None,
                             interpret: bool | None = None,
                             mesh=None, window: int = 0,
-                            pages_per_block: int = 1):
+                            pages_per_block: int = 1,
+                            spec: bool = False):
     """Build an ``attention_fn`` (llama.forward contract) over a paged cache.
 
     Constructed INSIDE the engine's jitted step function, closing over the
@@ -704,12 +712,43 @@ def make_paged_attention_fn(page_table: jax.Array, max_seq: int,
                 pages_per_block=pages_per_block, interpret=interpret)
         return out[:, None, :]
 
+    def verify(q, k_new, v_new, layer_k, layer_v, lengths, active=None):
+        """Deferred speculative verify: T = k+1 draft tokens attend the
+        STALE pool (gathered to a dense per-slot view) plus the causal
+        self-block, no pool write inside the layer scan — the insert
+        happens once via ``insert_all`` (T-generalized). Two wins over
+        the chunk path it replaces: (1) exact-greedy parity under int8 —
+        dense_verify_attention's mixed-precision self-block reads
+        off-diagonal drafts quantize→dequantized and the diagonal at
+        full precision, matching what plain decode sees, where the chunk
+        path reads even the SELF token quantized; (2) no per-layer pool
+        scatters through the spec burst scan (2·L serialized scatters
+        per verify step — the same cost insert_kv_stacked's dense twin
+        eliminates). The gather materializes [B, KV, max_seq, Dh] —
+        bounded by CONTEXT, not pool capacity, i.e. the same bytes one
+        decode step's attention streams anyway, amortized over k+1
+        positions."""
+        with jax.named_scope("attention.paged_verify"):
+            from ..models.llama import dense_verify_attention
+            n_stale = (lengths if active is None
+                       else jnp.where(active, lengths, 0))
+            dense_k = gather_pages(layer_k, page_table, max_seq)
+            dense_v = gather_pages(layer_v, page_table, max_seq)
+            return dense_verify_attention(q, k_new, v_new, dense_k,
+                                          dense_v, n_stale, None,
+                                          window=window)
+
     def insert_all(pool_k, pool_v, k_news, v_news, lengths, active):
         return paged_insert_all(pool_k, pool_v, k_news, v_news,
                                 page_table, lengths, active)
 
     attention_fn.decode = decode
     attention_fn.insert_all = insert_all
+    if spec:
+        # Spec-only provider: a `.verify` on the SHARED provider would
+        # reroute every prefill chunk (T > 1) through the deferred path;
+        # the engine builds a dedicated instance for spec bursts.
+        attention_fn.verify = verify
     return attention_fn
 
 

@@ -240,11 +240,16 @@ class HostBridge:
                                     table=table))
 
     def publish_spec(self, n_steps: int, reupload: bool, state: np.ndarray,
-                     table: np.ndarray | None = None) -> None:
+                     table: np.ndarray | None = None,
+                     probe: bool = False) -> None:
+        # Flags int: bit 0 = reupload, bit 1 = probe (per-slot adaptive
+        # drafting re-measure — the suspension mirror itself never rides
+        # the wire, it evolves identically on every process).
         if not self.enabled:
             return
         self._check_live()
-        self._broadcast(self._frame(OP_SPEC, n_steps, int(reupload),
+        flags = int(reupload) | (int(probe) << 1)
+        self._broadcast(self._frame(OP_SPEC, n_steps, flags,
                                     payload=state, table=table))
 
     def publish_shutdown(self) -> None:
@@ -290,7 +295,7 @@ class HostBridge:
                     raise RuntimeError(
                         "SPEC command on a non-speculative follower "
                         "(spec_draft_len mismatch across processes?)")
-                on_spec(int(cmd[1]), bool(cmd[2]),
+                on_spec(int(cmd[1]), int(cmd[2]),
                         self.unpack_decode_state(payload), table)
             else:
                 raise RuntimeError(f"unknown multihost opcode {op}")

@@ -45,6 +45,7 @@ _ENGINE_GAUGES = (
     # Speculative acceptance telemetry + flight-recorder loss (ISSUE 7).
     ("spec_proposed", "engine_spec_proposed_total", 1.0),
     ("spec_accepted", "engine_spec_accepted_total", 1.0),
+    ("spec_suspended_slots", "engine_spec_suspended_slots", 1.0),
     ("flight_evicted_total", "engine_flight_ring_evicted_total", 1.0),
     # HBM memory ledger (ISSUE 8): static accounting, live buffer bytes,
     # and the runtime allocator's view (device_* keys only exist where
@@ -98,6 +99,14 @@ def make_stats_collector(gw) -> "callable":
                     and isinstance(accepted, (int, float)):
                 metrics.engine_spec_acceptance_ratio.labels(
                     engine=name).set(accepted / proposed)
+            # Per-slot adaptive drafting: each measured slot's live EMA
+            # ratio (the floor's comparand), keyed by slot label.
+            ratios = stats.get("spec_slot_acceptance")
+            if isinstance(ratios, dict):
+                for slot, ratio in ratios.items():
+                    if isinstance(ratio, (int, float)):
+                        metrics.engine_spec_slot_acceptance_ratio.labels(
+                            engine=name, slot=str(slot)).set(ratio)
         # SLO goodput (ISSUE 7): met / (met + violated) per engine,
         # derived at scrape time from the counters the local provider
         # increments at stream end — the violated side sums across its

@@ -52,7 +52,7 @@ def test_bench_emits_contract_json_line():
                   "quant_int8_kv8", "long_ctx", "headline_8b",
                   "paged_sweep", "north_star", "spec_mixed",
                   "capacity_crossover", "swa", "quant_int4_kv8",
-                  "shared_prefix"):
+                  "shared_prefix", "spec_ladder"):
         assert field in extra, (field, sorted(extra))
     # The radix-cache rung proved reuse structurally: warm requests hit,
     # tokens were served from cache, and fewer prefill chunks dispatched.
@@ -99,3 +99,43 @@ def test_bench_emits_contract_json_line():
     kinds = {k["kind"] for k in kernels}
     assert "prefill" in kinds and "decode" in kinds, kernels
     assert "phase_errors" not in extra, extra["phase_errors"]
+    # Spec ladder (ISSUE 10): both quantization arms ran every draft
+    # depth on the paged layout; k>0 rungs measured acceptance,
+    # accepted tokens/step, the vs-spec-off ratio, and a per-arm kernel
+    # table with a worst_kernel pick; the int8 arm swept ppb.
+    lad = extra["spec_ladder"]
+    for arm in ("bf16", "int8"):
+        rungs = lad[arm]
+        assert set(rungs) >= {"spec0", "spec1", "spec3", "spec7"}, \
+            (arm, sorted(rungs))
+        assert rungs["spec0"]["tok_s"] > 0, rungs["spec0"]
+        for key in ("spec1", "spec3", "spec7"):
+            r = rungs[key]
+            assert r["tok_s"] > 0 and "vs_spec_off" in r, (key, r)
+            assert 0.0 <= r["acceptance"] <= 1.0, (key, r)
+            assert r["tokens_per_step"] >= 1.0, (key, r)
+            assert r["worst_kernel"], (key, r)
+            assert any(k.get("kind") == "spec" for k in r["kernels"]), key
+    # Kernel rows carry the quantization arm so worst_kernel() readings
+    # are filterable to the int8 decode variants.
+    assert any(k.get("variant_kv") == "int8"
+               for k in lad["int8"]["spec3"]["kernels"])
+    sweep = lad["int8"]["ppb_sweep"]
+    assert {"1", "2", "4", "best_pages_per_block"} <= set(sweep), sweep
+
+
+def test_committed_spec_ladder_artifact_parses():
+    """BENCH_SPEC_r10.json is the committed spec-ladder evidence: keep
+    it loadable and structurally complete (same pattern the roofline
+    tests apply to the committed ladder artifacts)."""
+    path = REPO / "BENCH_SPEC_r10.json"
+    assert path.exists(), "committed spec ladder artifact missing"
+    doc = json.loads(path.read_text())
+    assert doc["artifact"] == "BENCH_SPEC_r10"
+    lad = doc["spec_ladder"]
+    for arm in ("bf16", "int8"):
+        assert set(lad[arm]) >= {"spec0", "spec1", "spec3", "spec7"}
+        for key in ("spec1", "spec3", "spec7"):
+            assert lad[arm][key]["tok_s"] > 0
+            assert "acceptance" in lad[arm][key]
+    assert "ppb_sweep" in lad["int8"]

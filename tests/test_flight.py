@@ -218,3 +218,16 @@ def test_flight_ring_size_zero_disables(tmp_path):
     eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
     assert eng.flight is None
     assert "flight_seq" not in eng.stats()
+
+
+def test_spec_step_record_stamps_accepted_count():
+    """ISSUE 10 satellite: SPEC step records carry the accepted-draft
+    count; non-spec steps don't grow the field."""
+    rec = fl.FlightRecorder(clock=FakeClock())
+    rec.record(fl.STEP, flag=fl.F_DECODE | fl.F_SPEC, depth=2, tokens=9,
+               spec_acc=7)
+    rec.record(fl.STEP, flag=fl.F_DECODE, depth=2, tokens=2)
+    spec_d, dec_d = rec.snapshot()
+    assert spec_d["step_kind"] == "spec"
+    assert spec_d["spec_accepted"] == 7
+    assert "spec_accepted" not in dec_d

@@ -120,3 +120,18 @@ def test_bare_records_and_bad_input():
     assert any(e["ph"] == "X" for e in out["traceEvents"])
     with pytest.raises(ValueError, match="not a flight document"):
         flight_report.convert({"nope": 1})
+
+
+def test_spec_step_name_carries_accepted_tokens():
+    """ISSUE 10: SPEC step records carry their accepted-draft yield and
+    the converter surfaces it in the slice name (plus the full record in
+    args, like every step)."""
+    doc = {"records": [
+        {"seq": 0, "t": 50.0, "kind": "step", "dur_ms": 10.0,
+         "step_kind": "spec", "burst_depth": 2, "tokens": 9,
+         "spec_accepted": 7, "busy": False, "clamped": False},
+    ]}
+    out = flight_report.convert(doc)
+    (slice_ev,) = [e for e in out["traceEvents"] if e["ph"] == "X"]
+    assert slice_ev["name"] == "spec[2] +7acc"
+    assert slice_ev["args"]["spec_accepted"] == 7

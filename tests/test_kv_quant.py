@@ -394,15 +394,42 @@ def test_paged_sharded_adapter_matches_reference(setup, kv_quant):
 
 def test_kv_quant_guardrails():
     from llmapigateway_tpu.engine.engine import InferenceEngine
+    from tests.conftest import cpu_devices
 
     base = dict(preset="tiny-test", max_batch_size=1, max_seq_len=64,
                 compilation_cache_dir="off")
     with pytest.raises(ValueError, match="kv_quant"):
         InferenceEngine(LocalEngineConfig(kv_layout="contiguous",
         kv_quant="int4", **base))
-    # Speculation's exact-greedy guarantee can't hold against a quantized
-    # cache (the verify self-block sees drafts at full precision).
-    with pytest.raises(ValueError, match="speculative"):
-        InferenceEngine(LocalEngineConfig(kv_layout="contiguous",
-        kv_quant="int8", spec_draft_len=3,
+    # int8 + speculation now COMPOSES (the verify self-block went
+    # mixed-precision — drafted tokens quantize→dequantize exactly like
+    # the insert path): both layouts must build. Parity itself is pinned
+    # by tests/test_speculative.py's int8 parity tests.
+    for layout in ("contiguous", "paged"):
+        InferenceEngine(LocalEngineConfig(kv_layout=layout,
+                                          kv_quant="int8", spec_draft_len=3,
                                           **base))
+    # The one remaining hole: the seq-sharded PAGED verify rides the
+    # chunk path, which reads even the draft self token quantized —
+    # exact-greedy parity can't hold, so the build must refuse.
+    with pytest.raises(ValueError, match="seq-sharded"):
+        InferenceEngine(
+            LocalEngineConfig(kv_layout="paged", kv_quant="int8",
+                              spec_draft_len=3, mesh={"seq": 4},
+                              preset="tiny-test", max_batch_size=1,
+                              max_seq_len=256, kv_page_size=16,
+                              compilation_cache_dir="off"),
+            devices=cpu_devices()[:4])
+    # Same hole under pipeline sharding, either layout: the staged
+    # block verifies drafts on the chunk path by design
+    # (parallel/pipeline.py — no .verify provider), so int8+spec+pipe
+    # must refuse at build too.
+    for layout in ("contiguous", "paged"):
+        with pytest.raises(ValueError, match="pipeline"):
+            InferenceEngine(
+                LocalEngineConfig(kv_layout=layout, kv_quant="int8",
+                                  spec_draft_len=3, mesh={"pipe": 2},
+                                  preset="tiny-test", max_batch_size=1,
+                                  max_seq_len=256, kv_page_size=16,
+                                  compilation_cache_dir="off"),
+                devices=cpu_devices()[:2])

@@ -115,3 +115,40 @@ def test_cli_exit_codes(tmp_path, capsys):
     assert "hbm_gbps" in capsys.readouterr().out
     empty = _write(tmp_path, {"value": 0.0, "extra": {}}, "empty.json")
     assert rr.main([str(empty), "--json"]) == 1
+
+
+def test_spec_kernel_rows_marked_and_acceptance_adjusted(tmp_path, capsys):
+    """ISSUE 10: spec kernel rows get the ``spec`` marker and the owning
+    rung's acceptance-adjusted tokens/step (measured ``tokens_per_step``
+    preferred, else 1 + acceptance x draft_len), plus the registry's
+    variant_kv tag so the int8 arm is filterable."""
+    p = _write(tmp_path, {
+        "value": 100.0,
+        "extra": {"spec_ladder": {"int8": {"spec3": {
+            "tok_s": 120.0, "draft_len": 3, "acceptance": 0.8,
+            "kernels": [
+                {"kernel": "spec.s4", "kind": "spec", "calls": 12,
+                 "steps": 48, "variant_kv": "int8",
+                 "variant_layout": "paged", "roofline_fraction": 0.35,
+                 "pct_of_step_time": 60.0},
+                {"kernel": "decode.d4.greedy", "kind": "decode",
+                 "calls": 2, "steps": 8, "variant_kv": "int8",
+                 "roofline_fraction": 0.45, "pct_of_step_time": 40.0},
+            ]}}}}})
+    rows = rr.kernel_report([p])
+    by_kernel = {r["kernel"]: r for r in rows}
+    spec_row = by_kernel["spec.s4"]
+    assert spec_row["spec"] == "*"
+    # No measured tokens_per_step in the rung: derived 1 + 0.8*3.
+    assert spec_row["accepted_tok_per_step"] == pytest.approx(3.4)
+    assert spec_row["variant_kv"] == "int8"
+    # Decode rows stay unmarked but keep their kv tag.
+    assert "spec" not in by_kernel["decode.d4.greedy"]
+    assert by_kernel["decode.d4.greedy"]["variant_kv"] == "int8"
+    # A measured tokens_per_step wins over the derived value.
+    assert rr._accepted_tok_per_step(
+        {"tokens_per_step": 2.1, "acceptance": 0.8, "draft_len": 3}) == 2.1
+    # CLI renders the marker columns.
+    assert rr.main([str(p), "--kernels"]) == 0
+    out = capsys.readouterr().out
+    assert "accepted_tok_per_step" in out and "variant_kv" in out

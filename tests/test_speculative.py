@@ -73,19 +73,43 @@ async def test_spec_greedy_parity(spec):
         assert got.finish_reason == ref.finish_reason
 
 
+def _markovify(eng):
+    """Zero every layer's residual contributions (attention output and MLP
+    down projections), leaving hidden state = embed(token): logits become
+    a function of the CURRENT token only, so greedy decode is a fixed map
+    on the vocab whose iteration provably enters a cycle. That makes "the
+    model's output is repetitive" a structural guarantee instead of an
+    accident of random weights — the original form of this test relied on
+    a random tiny model greedily continuing its prompt's repetition, which
+    is a near-tie argmax accident that flips across boxes/compilers (it
+    did: known-failing since PR 7)."""
+    eng.params["layers"]["wo"] = jnp.zeros_like(eng.params["layers"]["wo"])
+    eng.params["layers"]["wd"] = jnp.zeros_like(eng.params["layers"]["wd"])
+
+
 async def test_spec_accepts_on_repetitive_text():
     """On a self-repeating greedy loop the acceptance rate must exceed
-    1 token/step — the whole point of speculating. Wall gate off: CPU
-    spec wall times would (correctly) close it, but ACCEPTANCE is the
+    1 token/step — the whole point of speculating. The model is Markov-
+    ified (see _markovify) so its greedy output is guaranteed to cycle;
+    acceptance then starts on the cycle's second lap, once the repetition
+    is in the slot's HISTORY (prompt-lookup drafts from past tokens — a
+    repetitive prompt alone proves nothing unless the model continues
+    it). Both adaptive gates are off: early drafts legitimately reject
+    (pre-cycle), and the acceptance gate would otherwise close and not
+    re-probe within this horizon (spec_probe_interval=25 rounds ≫ the
+    test's ~12) — the gates have their own tests; ACCEPTANCE is the
     subject here."""
     rng = np.random.default_rng(1)
     prompt = list(np.tile(rng.integers(2, 500, 4), 10))
-    eng = _engine(spec=3, spec_wall_gate=False)
+    eng = _engine(spec=3, spec_wall_gate=False,
+                  spec_min_tokens_per_step=0.0)
+    _markovify(eng)
     try:
-        await _gen(eng, prompt, max_tokens=40)
+        await _gen(eng, prompt, max_tokens=96)
         stats = eng.stats()
         assert stats["spec_draft_len"] == 3
         assert stats["spec_tokens_per_step"] > 1.0, stats
+        assert stats["spec_accepted"] > 0, stats
     finally:
         await eng.stop()
 
@@ -489,5 +513,284 @@ async def test_spec_acceptance_telemetry_and_metrics_bridge():
         ratio = val("gateway_engine_spec_acceptance_ratio")
         assert ratio == pytest.approx(s["spec_accepted"]
                                       / s["spec_proposed"])
+    finally:
+        await eng.stop()
+
+
+# -- int8 KV cache (the headline config) --------------------------------------
+
+@pytest.mark.parametrize("ppb", [1, 2, 4])
+async def test_spec_int8_greedy_parity_paged(ppb):
+    """Speculation over the PAGED int8 pool — the headline config — must
+    produce EXACTLY the spec-off greedy sequence, across pages_per_block
+    1/2/4. The verify self-block is mixed-precision (models/llama.py):
+    off-diagonal drafted K/V go through the SAME quantize→dequantize the
+    insert path applies, so verification judges each draft against the
+    numbers plain int8 decode would actually read; the diagonal stays
+    full precision like the decode self-column. (This combination was a
+    build-time ValueError before the fix.)"""
+    rng = np.random.default_rng(5)
+    prompt = list(np.tile(rng.integers(2, 500, 6), 8))
+    kw = dict(kv_layout="paged", kv_quant="int8", kv_page_size=16,
+              kv_pages_per_block=ppb)
+    ref_eng = _engine(spec=0, **kw)
+    try:
+        ref = await _gen(ref_eng, prompt, max_tokens=20)
+    finally:
+        await ref_eng.stop()
+    eng = _engine(spec=3, **kw)
+    try:
+        assert eng.kv_ppb == ppb
+        got = await _gen(eng, prompt, max_tokens=20)
+        assert got.generated == ref.generated, (
+            ppb, got.generated, ref.generated)
+        assert got.finish_reason == ref.finish_reason
+        assert eng._spec_steps_done > 0
+    finally:
+        await eng.stop()
+
+
+async def test_spec_int8_greedy_parity_contiguous():
+    """Same exactness over the CONTIGUOUS int8 cache (dense verify path),
+    on a repetitive prompt (acceptance exercised) and a random one
+    (drafts mostly rejected — the rejection numerics matter too)."""
+    rng = np.random.default_rng(6)
+    repetitive = list(np.tile(rng.integers(2, 500, 6), 8))
+    random_p = list(rng.integers(2, 500, 40))
+    for prompt in (repetitive, random_p):
+        ref_eng = _engine(spec=0, kv_quant="int8")
+        try:
+            ref = await _gen(ref_eng, prompt, max_tokens=20)
+        finally:
+            await ref_eng.stop()
+        eng = _engine(spec=3, kv_quant="int8")
+        try:
+            got = await _gen(eng, prompt, max_tokens=20)
+            assert got.generated == ref.generated, (
+                got.generated, ref.generated)
+            assert got.finish_reason == ref.finish_reason
+        finally:
+            await eng.stop()
+
+
+# -- per-slot adaptive drafting (spec_acceptance_floor) -----------------------
+
+def test_spec_walk_freezes_ema_and_suspends_below_floor():
+    """_spec_walk unit contract: a suspended (non-drafting) slot's rows
+    carry no acceptance signal — its EMA freezes and its proposal
+    counters don't move — while a drafting slot's EMA updates and its
+    suspension is re-derived from the floor."""
+    eng = _engine(spec=3, spec_acceptance_floor=0.5)
+    eng.active[:] = True
+    eng.lengths[:] = 10
+    eng.last_token[:] = 7
+    eng._spec_ema[:] = 2.0
+    drafting = np.array([True, False])
+    host = np.full((1, 2, 4), -1, np.int32)
+    host[0, 0, :] = [5, 6, 7, 8]          # slot 0: all 3 drafts accepted
+    host[0, 1, 0] = 5                     # slot 1 (suspended): 1 token/step
+    live = np.array([True, True])
+    eng._spec_walk(host, live.copy(), live.copy(), drafting=drafting)
+    assert eng._spec_ema[1] == 2.0                       # frozen
+    assert eng._spec_ema[0] == pytest.approx(3.0)        # 0.5*2 + 0.5*4
+    assert eng._spec_slot_proposed.tolist() == [3, 0]
+    assert eng._spec_slot_accepted.tolist() == [3, 0]
+    assert eng._spec_proposed_total == 3
+    assert eng._spec_accepted_total == 3
+    # ratio (3-1)/3 = 0.67 >= floor 0.5: slot 0 keeps drafting.
+    assert not eng._spec_suspended[0]
+    # Now a poor burst: 1 token/step while drafting -> ema falls toward
+    # 1, ratio below the floor -> suspended; the drafting mask flips off
+    # at the next _spec_draft_ok().
+    for _ in range(8):
+        host2 = np.full((1, 2, 4), -1, np.int32)
+        host2[0, 0, 0] = 9
+        host2[0, 1, 0] = 9
+        eng._spec_walk(host2, live.copy(), live.copy(),
+                       drafting=np.array([True, False]))
+    assert eng._spec_suspended[0]
+    assert not eng._spec_draft_ok(probe=False)[0]
+    assert eng._spec_draft_ok(probe=True).all()          # probe lifts it
+
+
+async def test_per_slot_floor_suspends_and_output_stays_exact():
+    """spec_acceptance_floor end-to-end: random (non-repetitive) text
+    can't clear an impossible floor, so the slot suspends after its
+    first measured burst; the scheduler then skips spec bursts (every
+    decoding slot benched) except the periodic lifted-mask probe — and
+    the output is STILL the exact greedy sequence. Suspension is
+    visible in stats() and bridged onto /metrics."""
+    rng = np.random.default_rng(21)
+    prompt = list(rng.integers(2, 500, 40))
+    ref_eng = _engine(spec=0)
+    try:
+        ref = await _gen(ref_eng, prompt, max_tokens=40)
+    finally:
+        await ref_eng.stop()
+    eng = _engine(spec=3, spec_acceptance_floor=1.0,
+                  spec_min_tokens_per_step=0.0, spec_wall_gate=False,
+                  spec_probe_interval=6)
+    try:
+        got = await _gen(eng, prompt, max_tokens=40)
+        assert got.generated == ref.generated, (
+            got.generated, ref.generated)
+        s = eng.stats()
+        assert s["spec_acceptance_floor"] == 1.0
+        assert s["spec_suspended_slots"] == 1, s
+        assert s["spec_slot_acceptance"], s
+        assert all(v < 1.0 for v in s["spec_slot_acceptance"].values())
+        # Suspension engaged early and stuck: far fewer spec steps ran
+        # than an always-on engine's (~40 tokens of rejected drafting).
+        assert eng._spec_steps_done < 20, eng._spec_steps_done
+
+        # /metrics: suspended-slot count + per-slot ratio gauges render
+        # under the exposition-grammar validator.
+        from llmapigateway_tpu.obs.metrics import (GatewayMetrics,
+                                                   MetricsRegistry)
+        from llmapigateway_tpu.server.obs_api import make_stats_collector
+
+        class _Prov:
+            engine = eng
+
+        class _Reg:
+            @staticmethod
+            def instantiated():
+                return [("tpu", _Prov())]
+
+        class _Tracer:
+            evicted_total = 0
+
+        class _GW:
+            metrics = GatewayMetrics(MetricsRegistry())
+            registry = _Reg()
+            breakers = None
+            tracer = _Tracer()
+
+        gw = _GW()
+        gw.metrics.registry.register_collector(make_stats_collector(gw))
+        from tests.test_metrics import validate_prometheus_text
+        families = validate_prometheus_text(gw.metrics.render())
+        susp = [v for _, labels, v in
+                families["gateway_engine_spec_suspended_slots_total"]["samples"]
+                if labels.get("engine") == "tpu"]
+        assert susp == [1.0]
+        slot_ratios = [
+            (labels["slot"], v) for _, labels, v in
+            families["gateway_engine_spec_slot_acceptance_ratio"]["samples"]
+            if labels.get("engine") == "tpu"]
+        assert slot_ratios and all(v < 1.0 for _, v in slot_ratios)
+    finally:
+        await eng.stop()
+
+
+async def test_per_slot_floor_releases_new_request_starts_fresh():
+    """A suspended slot's bench must not outlive its request: the next
+    admission on that slot resets EMA + suspension (new text owes
+    nothing to the old regime), so drafting re-engages immediately."""
+    rng = np.random.default_rng(22)
+    prompt = list(rng.integers(2, 500, 40))
+    eng = _engine(spec=3, spec_acceptance_floor=1.0,
+                  spec_min_tokens_per_step=0.0, spec_wall_gate=False,
+                  spec_probe_interval=1000)
+    try:
+        await _gen(eng, prompt, max_tokens=24)
+        assert eng.stats()["spec_suspended_slots"] == 1
+        steps_before = eng._spec_steps_done
+        await _gen(eng, prompt, max_tokens=24)
+        # Fresh request drafted again (the optimistic NaN prior) — spec
+        # steps advanced despite the probe interval being unreachable.
+        assert eng._spec_steps_done > steps_before
+        assert eng.stats()["spec_suspended_slots"] == 1   # re-benched
+    finally:
+        await eng.stop()
+
+
+# -- composition: prefix cache, cancellation chaos ----------------------------
+
+async def test_spec_composes_with_prefix_cache_insert_on_release():
+    """Spec over the paged pool + radix prefix cache: spec bursts write
+    K/V beyond `lengths` into the cache's undefined zone, and
+    insert-on-release must index only the VERIFIED prefix — a warm
+    rerun over spec-written pages yields byte-identical tokens with a
+    real prefix hit."""
+    rng = np.random.default_rng(23)
+    prompt = list(np.tile(rng.integers(2, 500, 6), 8))    # 48 tokens
+    cfg = LocalEngineConfig(preset="tiny-test", max_batch_size=2,
+                            max_seq_len=192, prefill_chunk=16,
+                            dtype="float32", decode_burst=8,
+                            decode_burst_busy=8, spec_draft_len=3,
+                            kv_layout="paged", kv_page_size=16,
+                            spec_wall_gate=False,
+                            spec_min_tokens_per_step=0.0)
+    eng = InferenceEngine(cfg, devices=[jax.devices("cpu")[0]])
+    try:
+        assert eng._prefix_cache is not None
+        cold = await _gen(eng, prompt, max_tokens=20)
+        warm = await _gen(eng, prompt, max_tokens=20)
+        assert warm.cached_tokens > 0
+        assert cold.generated == warm.generated, (
+            cold.generated, warm.generated)
+        assert eng._spec_steps_done > 0       # spec actually ran
+        eng._prefix_cache.check_invariants()
+        s = eng.stats()
+        assert s["prefix_hits_total"] == 1
+    finally:
+        await eng.stop()
+
+
+async def test_cancel_during_inflight_spec_burst_no_leaks():
+    """Chaos: cancel a request while a speculative burst is in flight
+    (lag-one). The flush's epoch guard masks the dead slot's rows, the
+    slot and all its pages come back, the flight lifecycle stays
+    balanced (admits == finishes), and the engine keeps serving."""
+    rng = np.random.default_rng(24)
+    prompt = list(np.tile(rng.integers(2, 500, 4), 10))
+    eng = _engine(spec=3, kv_layout="paged", kv_page_size=16,
+                  prefix_cache=False, spec_wall_gate=False,
+                  spec_min_tokens_per_step=0.0)
+    try:
+        total_free = eng.allocator.free_pages
+        req = GenRequest(prompt_ids=list(prompt), max_tokens=10_000,
+                         temperature=0.0)
+        await eng.submit(req)
+        # A few generated tokens prove decode (and with the gates forced
+        # open, speculative bursts) is underway; then cancel mid-stream
+        # like a disconnecting client — a spec burst is in flight more
+        # often than not at this point (lag-one dispatch). Polling
+        # req.generated, not out_queue: the tiny-test detokenizer may
+        # hold text back for arbitrary token ids, so the first DELTA can
+        # lag the first token by the whole stream.
+        for _ in range(1200):
+            if len(req.generated) >= 2:
+                break
+            await asyncio.sleep(0.05)
+        assert len(req.generated) >= 2, "decode never started"
+        req.cancelled = True
+        for _ in range(400):
+            if req.finish_reason is not None:
+                break
+            await asyncio.sleep(0.05)
+        assert req.finish_reason == "cancelled"
+        for _ in range(400):
+            if len(eng._free_slots) == eng.B:
+                break
+            await asyncio.sleep(0.05)
+        assert len(eng._free_slots) == eng.B
+        assert eng.allocator.free_pages == total_free    # zero page leak
+        fs = eng.flight.stats()
+        assert fs["flight_admits"] == fs["flight_finishes"]
+        # Still serviceable, still exact: a fresh greedy request matches
+        # a clean engine's output.
+        after = await _gen(eng, prompt, max_tokens=12)
+        clean = _engine(spec=3, kv_layout="paged", kv_page_size=16,
+                        prefix_cache=False, spec_wall_gate=False,
+                        spec_min_tokens_per_step=0.0)
+        try:
+            want = await _gen(clean, prompt, max_tokens=12)
+        finally:
+            await clean.stop()
+        assert after.generated == want.generated
+        fs = eng.flight.stats()
+        assert fs["flight_admits"] == fs["flight_finishes"]
     finally:
         await eng.stop()

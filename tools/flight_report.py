@@ -41,7 +41,14 @@ TID_SLOT_BASE = 2
 def _step_name(rec: dict[str, Any]) -> str:
     kind = rec.get("step_kind", "step")
     depth = rec.get("burst_depth")
-    return f"{kind}[{depth}]" if depth else kind
+    name = f"{kind}[{depth}]" if depth else kind
+    # Spec steps carry their accepted-draft yield (ISSUE 10): surface it
+    # in the slice name so acceptance is readable from the timeline
+    # without opening each slice's detail pane.
+    acc = rec.get("spec_accepted")
+    if kind == "spec" and isinstance(acc, int):
+        name += f" +{acc}acc"
+    return name
 
 
 def _meta(pid: int, tid: int | None, name: str, value: str) -> dict:

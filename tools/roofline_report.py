@@ -52,9 +52,16 @@ def find_rungs(node, path="") -> list[tuple[str, dict]]:
 
 def load_result(path: Path) -> dict:
     """A ladder file is one JSON line (possibly preceded by log noise —
-    take the last parseable line, same contract the driver applies)."""
+    take the last parseable line, same contract the driver applies).
+    Committed artifacts (``BENCH_*_r*.json``) are pretty-printed whole
+    files instead, so try that first."""
+    text = path.read_text()
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        pass
     last_err = None
-    for line in reversed(path.read_text().strip().splitlines()):
+    for line in reversed(text.strip().splitlines()):
         line = line.strip()
         if not line.startswith("{"):
             continue
@@ -71,7 +78,9 @@ def report(paths: list[Path], peak_gbps: float = 0.0) -> list[dict]:
     rows = []
     for p in paths:
         result = load_result(p)
-        rungs = find_rungs(result.get("extra", {}))
+        # Bench lines nest rungs under "extra"; committed artifacts are
+        # the rung tree directly.
+        rungs = find_rungs(result.get("extra", result))
         # The headline tok_s lives at the result's top level, not in extra.
         if "value" in result and result.get("value"):
             for name, rung in rungs:
@@ -95,13 +104,36 @@ KERNEL_COLUMNS = ("calls", "steps", "step_ms", "pct_of_step_time",
                   "hbm_bytes_per_step", "achieved_gbps",
                   "roofline_fraction", "xla_flops_per_call",
                   "xla_bytes_per_call")
+# Identity columns kept as strings: variant_kv ("int8"/"bf16") filters
+# the worst-kernel reading to the quantization arm being worked.
+KERNEL_TAG_COLUMNS = ("variant_kv", "variant_layout")
+
+
+def _accepted_tok_per_step(rung: dict):
+    """Acceptance-adjusted tokens per verify step for a spec rung: a
+    depth-k verify step emits 1 + accepted drafts, so raw step_ms
+    under-credits spec kernels by exactly this factor. Prefer the rung's
+    measured ``tokens_per_step``; else derive from acceptance × draft."""
+    tps = rung.get("tokens_per_step")
+    if isinstance(tps, (int, float)):
+        return tps
+    acc, k = rung.get("acceptance"), rung.get("draft_len")
+    if isinstance(acc, (int, float)) and isinstance(k, (int, float)):
+        return round(1.0 + acc * k, 2)
+    return None
 
 
 def kernel_report(paths: list[Path]) -> list[dict]:
     """One row per (file, rung, kernel) from any rung carrying a
     ``kernels`` list, ranked worst first: ascending roofline fraction
     (kernels without one sort after measured ones), descending step-time
-    share as the tiebreak — the top row is the next kernel target."""
+    share as the tiebreak — the top row is the next kernel target.
+
+    Spec kernels (kind "spec" / ``spec.*`` names) are marked with a
+    ``spec`` column and, when the owning rung measured acceptance, an
+    ``accepted_tok_per_step`` column — a verify step emits multiple
+    tokens, so its per-step wall must be read against that yield or spec
+    wins never show up in the table (ISSUE 10)."""
     rows: list[dict] = []
     for p in paths:
         result = load_result(p)
@@ -118,11 +150,20 @@ def kernel_report(paths: list[Path]) -> list[dict]:
                         for col in KERNEL_COLUMNS:
                             if isinstance(k.get(col), (int, float)):
                                 row[col] = k[col]
+                        for col in KERNEL_TAG_COLUMNS:
+                            if isinstance(k.get(col), str):
+                                row[col] = k[col]
+                        if (k.get("kind") == "spec"
+                                or str(k["kernel"]).startswith("spec.")):
+                            row["spec"] = "*"
+                            tps = _accepted_tok_per_step(node)
+                            if tps is not None:
+                                row["accepted_tok_per_step"] = tps
                         rows.append(row)
             for key, val in node.items():
                 if key != "kernels":
                     walk(val, f"{path}.{key}" if path else key)
-        walk(result.get("extra", {}))
+        walk(result.get("extra", result))
     rows.sort(key=lambda r: (r.get("roofline_fraction", float("inf")),
                              -r.get("pct_of_step_time", 0.0)))
     return rows
@@ -170,8 +211,9 @@ def main(argv: list[str] | None = None) -> int:
             print()
             print("Per-kernel rows (worst roofline fraction first):")
             print(format_table(
-                krows, columns=("file", "rung", "kernel",
-                                *KERNEL_COLUMNS)))
+                krows, columns=("file", "rung", "kernel", "spec",
+                                *KERNEL_TAG_COLUMNS, *KERNEL_COLUMNS,
+                                "accepted_tok_per_step")))
     return 0 if rows or krows else 1
 
 
