@@ -643,4 +643,10 @@ def default_instrumented_classes() -> list[type]:
     # that contract testable instead of aspirational.
     from ..obs.flight import FlightRecorder
     classes.append(FlightRecorder)
+    # The disaggregation controller + pools (ISSUE 13) are scheduler
+    # state carved out of the engine — same loop-thread-only contract,
+    # same enforcement. jax-free module, so no import guard.
+    from ..engine.disagg import DisaggController, SlotPool
+    classes.append(DisaggController)
+    classes.append(SlotPool)
     return classes

@@ -19,6 +19,39 @@ class ConfigError(Exception):
     """Raised on invalid configuration; callers decide whether to exit."""
 
 
+class DisaggregationConfig(BaseModel):
+    """Prefill/decode disaggregation knobs (engine/disagg.py, ISSUE 13).
+
+    When enabled, the engine's batch slots split into a prefill pool and
+    a decode pool over ONE shared paged KV pool; a completed prefill
+    hands its KV to the decode pool by allocator refcount transfer (zero
+    device copies). Requires ``kv_layout: paged``; incompatible with
+    multihost, seq/pipe sharding, speculative decoding and SWA ring mode
+    (rejected at engine build).
+    """
+    model_config = ConfigDict(extra="forbid")
+
+    enabled: bool = False
+    # Slots reserved for the prefill pool; 0 = auto (max(1, B // 4)).
+    # Must leave at least one decode slot: 0 <= prefill_slots < B.
+    prefill_slots: int = Field(default=0, ge=0)
+    # "goodput": predict per-pool TTFT/TPOT attainment from fitted step
+    # times + flight-ring decode occupancy + queue depth, shed (429 +
+    # Retry-After) when the decode pool's predicted TPOT misses the
+    # request's SLO, clamp (mark-only) when only TTFT is at risk.
+    # "always": admit everything the watermark allows (telemetry still
+    # flows; A/B baseline for the bench's --disagg-ab rung).
+    admission: str = "goodput"
+
+    @field_validator("admission")
+    @classmethod
+    def _admission_known(cls, v: str) -> str:
+        if v not in ("goodput", "always"):
+            raise ValueError(
+                f"admission must be 'goodput' or 'always', got {v!r}")
+        return v
+
+
 class LocalEngineConfig(BaseModel):
     """Engine settings for a ``type: local`` provider entry.
 
@@ -214,6 +247,11 @@ class LocalEngineConfig(BaseModel):
     # markers (decode.attention / decode.mlp / sampling) are trace-time
     # metadata and cannot be disabled because they cost nothing.
     profile_annotations: bool = True
+    # Prefill/decode disaggregation (ISSUE 13): two pools, one paged KV
+    # pool, zero-copy handoff, goodput-first admission. Default off —
+    # the unified scheduler is byte-identical to pre-pool behavior.
+    disaggregation: DisaggregationConfig = Field(
+        default_factory=DisaggregationConfig)
 
 
 class BreakerSettings(BaseModel):

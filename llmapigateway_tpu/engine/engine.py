@@ -164,6 +164,16 @@ class GenRequest:
     # request's admit/finish records, surfaced as trace-span attributes.
     flight_admit_seq: int = -1
     flight_done_seq: int = -1
+    # Disaggregated serving (ISSUE 13): which pool currently owns the
+    # request (obs.flight POOL_* tag; 0 on a unified engine), the decode
+    # slot reserved at admission for the prefill→decode handoff (-1 =
+    # none; equals `slot` after the handoff or on direct-to-decode
+    # admissions), and whether goodput admission flagged this request
+    # as TTFT-clamped (burst depth held at the busy/interleave rung
+    # until its first token).
+    pool: int = 0
+    decode_slot: int = -1
+    disagg_clamped: bool = False
 
     @property
     def done(self) -> bool:
@@ -439,7 +449,24 @@ class InferenceEngine:
         self._queue: asyncio.Queue[GenRequest] = asyncio.Queue(
             maxsize=max(2 * self.B, 16))                # guarded-by: loop
         self._head: GenRequest | None = None            # guarded-by: loop
-        self._free_slots = list(range(self.B))          # guarded-by: loop
+        # Slot ownership lives in SlotPool objects (engine/disagg.py,
+        # ISSUE 13): ONE pool spanning every slot for the unified
+        # scheduler, or a prefill + decode pair sharing this mesh and KV
+        # pool in disaggregated mode — where admission reserves a decode
+        # slot up front and prompt completion hands the KV over by page
+        # refcount transfer (_handoff), never by device copy.
+        from .disagg import DisaggController, build_pools
+        self._disagg: DisaggController | None = None
+        if engine_cfg.disaggregation.enabled:
+            self._disagg = DisaggController(
+                self, engine_cfg.disaggregation)
+            self._pools = self._disagg.pools            # guarded-by: loop
+        else:
+            self._pools = build_pools(self.B)           # guarded-by: loop
+        self._pool_by_slot = {s: p for p in self._pools
+                              for s in p.slots}
+        self._admit_pool = self._pools[0]     # prefill pool when disagg
+        self._decode_pool = self._pools[-1]   # same object when unified
         self._running: dict[int, GenRequest] = {}       # guarded-by: loop
         self._prefilling: dict[int, GenRequest] = {}    # guarded-by: loop
         self._loop_task: asyncio.Task | None = None
@@ -1355,12 +1382,18 @@ class InferenceEngine:
                 if self.flight is not None:
                     from ..obs.flight import SHED
                     self.flight.record(SHED, queued=self._queue.qsize(),
-                                       free_slots=len(self._free_slots),
+                                       free_slots=self._free_slot_count(),
                                        val=frac,
                                        rid=req.request_id or None)
                 raise EngineOverloaded(
                     f"device memory headroom {frac:.1%} below the "
                     f"{wm:.0%} watermark")
+        if self._disagg is not None:
+            # Goodput-first admission (ISSUE 13): shed now — 429 with a
+            # numeric Retry-After through the same path as a full queue
+            # — when neither pool's predicted attainment meets the
+            # request's SLO; a TTFT-only risk admits clamped instead.
+            self._disagg.admit_or_shed(req)
         req.detok = IncrementalDetokenizer(self.tokenizer)
         try:
             self._queue.put_nowait(req)
@@ -1369,11 +1402,28 @@ class InferenceEngine:
             if self.flight is not None:
                 from ..obs.flight import SHED
                 self.flight.record(SHED, queued=self._queue.qsize(),
-                                   free_slots=len(self._free_slots),
+                                   free_slots=self._free_slot_count(),
                                    rid=req.request_id or None)
             raise EngineOverloaded("engine admission queue is full") from None
         await self.start()
         self._work_event.set()
+
+    def _free_slot_count(self) -> int:
+        """Free slots across every pool (ONE pool unified, two disagg)."""
+        return sum(len(p.free) for p in self._pools)
+
+    @property
+    def _free_slots(self) -> list:
+        """The admit pool's free list — the WHOLE free list when
+        disaggregation is off (one pool), the prefill pool's under
+        disaggregation. The pre-pool name, kept because the test surface
+        and operator debug consoles reach for it; writes pass through to
+        the pool so fault-injection tests can still pin slots."""
+        return self._admit_pool.free
+
+    @_free_slots.setter
+    def _free_slots(self, slots) -> None:
+        self._admit_pool.free = slots
 
     def retry_after_hint_s(self) -> float:
         """How long a just-shed client should wait before retrying, from the
@@ -1431,7 +1481,8 @@ class InferenceEngine:
                 # instead of failing every subsequent step on a deleted array.
                 try:
                     self._init_state()
-                    self._free_slots = list(range(self.B))
+                    for pool in self._pools:
+                        pool.reset_free()
                     self._running.clear()
                     self._prefilling.clear()
                 except Exception:
@@ -1460,7 +1511,19 @@ class InferenceEngine:
         #    Paged layout: the FIFO head also needs its full page reservation
         #    (engine/paged.py policy) — if pages are short it waits at the
         #    head (no starvation: held pages always return via releases).
-        while self._free_slots:
+        while True:
+            # Pool capacity gate (ISSUE 13): the unified pool just needs
+            # any free slot; a disaggregated COLD admission needs a free
+            # prefill slot AND a free decode slot to reserve (so the
+            # handoff can never strand a prompt-complete request), while
+            # the direct-to-decode path (warm prefix hit / penalties —
+            # decided below, after the prefix lookup) needs only the
+            # decode slot.
+            cold_ok = bool(self._admit_pool.free) and (
+                self._disagg is None or bool(self._decode_pool.free))
+            if not cold_ok and not (self._disagg is not None
+                                    and self._decode_pool.free):
+                break
             if self._head is None:
                 if self._queue.empty():
                     break
@@ -1512,8 +1575,41 @@ class InferenceEngine:
                     if cache is not None:
                         cache.release_nodes(nodes)
                     break
+            direct = False
+            if self._disagg is not None:
+                # Direct-to-decode placement (no handoff): a warm prefix
+                # hit whose unmatched tail fits ONE chunk skips the
+                # prefill pool entirely (the matched span never prefills
+                # at all — the composition the radix cache buys), and a
+                # penalty request must build its on-device token counts
+                # on the slot that will decode it (it bypasses the
+                # prefix cache for the same reason, so matched == 0).
+                direct = (req.presence_penalty != 0
+                          or req.frequency_penalty != 0
+                          or (matched > 0
+                              and len(req.prompt_ids) - matched
+                              <= self.prefill_chunk))
+                if not direct and not cold_ok:
+                    # Cold prompt but no prefill slot (or no decode slot
+                    # to reserve): park at the FIFO head, exactly like a
+                    # page-reservation shortfall.
+                    if cache is not None:
+                        cache.release_nodes(nodes)
+                    break
+            if self._disagg is None:
+                target_pool = self._admit_pool
+                req.slot = target_pool.take()
+            elif direct:
+                target_pool = self._decode_pool
+                req.slot = target_pool.take()
+                req.decode_slot = req.slot
+            else:
+                target_pool = self._admit_pool
+                req.slot = target_pool.take()
+                req.decode_slot = self._decode_pool.take()  # reservation
+            req.pool = target_pool.pool_id
+            target_pool.admits += 1
             self._head = None
-            req.slot = self._free_slots.pop()
             # Queue-wait gauge (submit → slot admission): the scheduler
             # half of TTFT — what the prefill-aware burst clamp bounds.
             # t_admitted also closes the trace's engine.queued phase.
@@ -1563,11 +1659,13 @@ class InferenceEngine:
                     ADMIT, slot=req.slot, val=wait_ms,
                     tokens=req.cached_tokens,
                     queued=self._queue.qsize() + (1 if self._head else 0),
-                    free_slots=len(self._free_slots),
+                    free_slots=self._free_slot_count(),
                     free_pages=(self.allocator.free_pages if self.paged
                                 else -1),
+                    pool=req.pool,
                     rid=req.request_id or None)
 
+        t_pf0 = fl.clock() if fl is not None else 0.0
         # 2. Advance each pending prefill by ONE chunk (chunked-prefill
         #    interleave: a long prompt never blocks decode for more than one
         #    chunk — SURVEY.md §7 hard part (6)). Same-bucket chunks group
@@ -1598,6 +1696,8 @@ class InferenceEngine:
                 n_chunks += 1
                 if prompt_done:
                     del self._prefilling[req.slot]
+                    if self._disagg is not None:
+                        self._handoff(req)
                     n_tok += 1
                     self._emit_token(req)  # first token, sampled off prefill
         else:
@@ -1630,8 +1730,33 @@ class InferenceEngine:
                     for req, prompt_done in zip(batch, dones):
                         if prompt_done:
                             del self._prefilling[req.slot]
+                            if self._disagg is not None:
+                                self._handoff(req)
                             n_tok += 1
                             self._emit_token(req)
+
+        n_tok_prefill = n_tok           # first tokens, sampled off prefill
+        if self._disagg is not None and fl is not None and n_chunks:
+            # Disaggregated mode emits the PREFILL pool's step record
+            # here, with its own wall window, so the per-pool Perfetto
+            # lanes (tools/flight_report.py) show where each pool's time
+            # actually went; the decode pool's record lands after the
+            # burst below. A unified engine keeps its single combined
+            # record — snapshot-identical to the pre-pool format.
+            from ..obs import flight as _fl
+            pf_wall_ms = 1000.0 * (fl.clock() - t_pf0)
+            self._disagg.note_prefill_wall(pf_wall_ms / n_chunks)
+            fitted = self._ema_step_ms_stats
+            fl.record(
+                _fl.STEP, flag=_fl.F_PREFILL, chunks=n_chunks,
+                tokens=n_tok_prefill, dur_ms=pf_wall_ms,
+                pool=_fl.POOL_PREFILL,
+                active=len(self._running),
+                free_slots=self._free_slot_count(),
+                queued=self._queue.qsize() + (1 if self._head else 0),
+                free_pages=self.allocator.free_pages,
+                fitted_ms=(fitted if fitted is not None
+                           else float("nan")))
 
         # 3. A decode burst for all slots in decode phase. Burst depth adapts:
         #    stay shallow when new work is waiting (prefill responsiveness →
@@ -1833,7 +1958,7 @@ class InferenceEngine:
                     n_tok += 1
                     self._emit_token(req)
         progressed = bool(decoding) or bool(self._prefilling)
-        if not progressed and self._free_slots and (
+        if not progressed and self._free_slot_count() and (
                 self._head is not None or not self._queue.empty()):
             # Slots freed DURING this step (e.g. every prefilling request
             # cancelled mid-chunk) while admissions still wait: phase 1
@@ -1865,19 +1990,40 @@ class InferenceEngine:
             # fit walks every wall sample and would cost more per step
             # than the record itself.
             fitted = self._ema_step_ms_stats
-            fl.record(
-                _fl.STEP, flag=flag, depth=depth, tokens=n_tok,
-                chunks=n_chunks,
-                dur_ms=1000.0 * (fl.clock() - t_step0),
-                spec_acc=spec_acc_n,
-                val=dec_wall_ms if decoding else 0.0,
-                active=len(self._running),
-                free_slots=len(self._free_slots),
-                queued=self._queue.qsize() + (1 if self._head else 0),
-                free_pages=(self.allocator.free_pages if self.paged
-                            else -1),
-                fitted_ms=(fitted if fitted is not None
-                           else float("nan")))
+            if self._disagg is not None:
+                # The prefill pool's share of this iteration already went
+                # out after phase 2; this record is the decode pool's
+                # view (dur = burst wall, so steps_overlapping() sums
+                # true decode occupancy). Prefill-only iterations emit
+                # nothing here.
+                if decoding:
+                    fl.record(
+                        _fl.STEP, flag=flag & ~_fl.F_PREFILL,
+                        depth=depth, tokens=n_tok - n_tok_prefill,
+                        dur_ms=dec_wall_ms,
+                        val=dec_wall_ms,
+                        pool=_fl.POOL_DECODE,
+                        active=len(self._running),
+                        free_slots=self._free_slot_count(),
+                        queued=(self._queue.qsize()
+                                + (1 if self._head else 0)),
+                        free_pages=self.allocator.free_pages,
+                        fitted_ms=(fitted if fitted is not None
+                                   else float("nan")))
+            else:
+                fl.record(
+                    _fl.STEP, flag=flag, depth=depth, tokens=n_tok,
+                    chunks=n_chunks,
+                    dur_ms=1000.0 * (fl.clock() - t_step0),
+                    spec_acc=spec_acc_n,
+                    val=dec_wall_ms if decoding else 0.0,
+                    active=len(self._running),
+                    free_slots=self._free_slot_count(),
+                    queued=self._queue.qsize() + (1 if self._head else 0),
+                    free_pages=(self.allocator.free_pages if self.paged
+                                else -1),
+                    fitted_ms=(fitted if fitted is not None
+                               else float("nan")))
         return progressed
 
     # -- compute (worker thread; no asyncio objects touched) ------------------
@@ -3001,17 +3147,67 @@ class InferenceEngine:
                     FINISH, slot=req.slot, flag=code,
                     tokens=len(req.generated),
                     active=len(self._running),
-                    free_slots=len(self._free_slots),
+                    free_slots=self._free_slot_count(),
+                    pool=req.pool,
                     rid=req.request_id or None)
             self._prefilling.pop(req.slot, None)
             self.active[req.slot] = False
             self.lengths[req.slot] = 0
-            self._free_slots.append(req.slot)
+            self._pool_by_slot[req.slot].free.append(req.slot)
+            if req.decode_slot >= 0 and req.decode_slot != req.slot:
+                # Cold admission cancelled/shed mid-prefill: its reserved
+                # decode slot was never consumed by a handoff — return it
+                # or the decode pool leaks a slot per aborted prefill.
+                self._decode_pool.free.append(req.decode_slot)
+            req.decode_slot = -1
+            if self._disagg is not None:
+                self._disagg.clamp_release(req)
             self._slot_epoch[req.slot] += 1
             self._d_dirty = True
             if self.paged:
                 self.allocator.release(req.slot)
                 self._table_dirty = True
+
+    def _handoff(self, req: GenRequest) -> None:
+        """Promote a just-completed prefill into the decode pool
+        (ISSUE 13). Zero-copy: the KV pages move by refcount transfer
+        inside the allocator (same physical ids, no device memcpy) and
+        only the HOST page table + per-slot mirrors change rows — the
+        next dirty upload carries both. Runs on the loop thread in the
+        gap between the prefill dispatch returning and the next decode
+        burst, so no in-flight burst has ever seen ``active`` true for
+        either slot: lag-one ``_pending`` snapshots predate the move and
+        mask both rows to -1."""
+        from ..obs.flight import POOL_DECODE, POOL_PREFILL
+        if req.disagg_clamped:
+            self._disagg.clamp_release(req)
+        if req.pool != POOL_PREFILL:
+            return      # admitted direct-to-decode: already home
+        p, d = req.slot, req.decode_slot
+        pages = self.allocator.transfer(p, d)
+        self.lengths[d] = self.lengths[p]
+        self.last_token[d] = self.last_token[p]
+        self.samp_temperature[d] = self.samp_temperature[p]
+        self.samp_top_p[d] = self.samp_top_p[p]
+        self.samp_top_k[d] = self.samp_top_k[p]
+        self.samp_presence[d] = self.samp_presence[p]
+        self.samp_frequency[d] = self.samp_frequency[p]
+        # (Penalty count rows are NOT moved: requests with penalties are
+        # admitted direct-to-decode so their on-device counts build in
+        # place; a penalty-free request's stale counts row is multiplied
+        # by zero.)
+        self.active[d] = True
+        self.active[p] = False
+        self.lengths[p] = 0
+        self._slot_epoch[p] += 1
+        self._d_dirty = True
+        self._table_dirty = True
+        del self._running[p]
+        self._running[d] = req
+        req.slot = d
+        req.pool = POOL_DECODE
+        self._admit_pool.free.append(p)
+        self._disagg.note_handoff(len(pages))
 
     # -- stats ----------------------------------------------------------------
     def _resident_param_bytes(self) -> int:
@@ -3132,11 +3328,17 @@ class InferenceEngine:
         out = {
             "running": len(self._running),
             "queued": self._queue.qsize() + (1 if self._head else 0),
-            "free_slots": len(self._free_slots),
+            "free_slots": self._free_slot_count(),
             "batch_size": self.B,
             "max_seq_len": self.S,
             "kv_layout": self.cfg.kv_layout,
         }
+        if self._disagg is not None:
+            out["pools"] = self._disagg.stats()
+            out["disagg_handoffs"] = self._disagg.handoffs
+            out["disagg_handoff_pages"] = self._disagg.handoff_pages
+            out["disagg_clamps"] = self._disagg.clamps
+            out["disagg_goodput_sheds"] = self._disagg.goodput_sheds
         # Precision config — operators correlating quality/throughput need
         # to see what the engine is actually running.
         if self.quant:

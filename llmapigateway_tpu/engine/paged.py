@@ -284,6 +284,33 @@ class PageAllocator:
             changed = True
         return changed
 
+    def transfer(self, src_slot: int, dst_slot: int) -> list[int]:
+        """Move ``src_slot``'s entire holding to ``dst_slot`` — the
+        disaggregated prefill→decode KV handoff (ISSUE 13). Zero-copy by
+        construction: the new owner retains every group FIRST, the table
+        row is copied, then the old owner releases — net refcounts are
+        unchanged and never dip through zero mid-transfer, so no page
+        touches a free list and the same physical ids stay mapped (the
+        device cache is untouched; callers only re-upload the page
+        table). Returns the transferred page list so the engine can
+        assert page-id identity across the handoff."""
+        if dst_slot in self._held:
+            raise ValueError(f"slot {dst_slot} already holds pages")
+        if src_slot in self._ring_slots:
+            # Ring rows rotate their mappings in place; handing one off
+            # would need dst to inherit rotation state. The engine gates
+            # disagg off SWA-ring builds, so this is a misuse guard.
+            raise ValueError("cannot transfer a ring-mode slot")
+        pages = self._held.get(src_slot)
+        if pages is None:
+            raise ValueError(f"slot {src_slot} holds no pages")
+        for g in self._groups_of(pages):
+            self._ref[g] += 1
+        self.table[dst_slot, :] = self.table[src_slot, :]
+        self._held[dst_slot] = pages
+        self.release(src_slot)
+        return pages
+
     def release(self, slot: int) -> None:
         pages = self._held.pop(slot, None)
         if pages:

@@ -56,6 +56,17 @@ KIND_NAMES = {STEP: "step", ADMIT: "admit", FINISH: "finish",
 PROF_START = 1
 PROF_STOP = 2
 
+# Pool tags (ISSUE 13, disaggregated serving): which scheduler pool
+# emitted the record. 0 = the unified (single-pool) scheduler — the
+# value every pre-disagg ring carries, so unified snapshots are
+# byte-identical to the pre-pool format (the field is only emitted
+# when nonzero).
+POOL_UNIFIED = 0
+POOL_PREFILL = 1
+POOL_DECODE = 2
+POOL_NAMES = {POOL_UNIFIED: "unified", POOL_PREFILL: "prefill",
+              POOL_DECODE: "decode"}
+
 # STEP flag bits: what the scheduler iteration actually ran.
 F_PREFILL = 1     # >=1 prefill chunk dispatched
 F_DECODE = 2      # a decode burst ran
@@ -84,6 +95,7 @@ _DTYPE = np.dtype([
     ("spec_acc", np.int32),     # SPEC steps: accepted draft tokens this
                                 # burst (tokens - spec_acc = what a plain
                                 # burst of the same depth would have made)
+    ("pool", np.uint8),         # POOL_* tag; 0 = unified scheduler
 ])
 
 FINISH_REASONS = ("stop", "length", "cancelled", "error")
@@ -133,7 +145,8 @@ class FlightRecorder:
                chunks: int = 0, active: int = 0, free_slots: int = 0,
                queued: int = 0, free_pages: int = -1,
                fitted_ms: float = math.nan, val: float = 0.0,
-               spec_acc: int = 0, rid: str | None = None) -> int:
+               spec_acc: int = 0, pool: int = 0,
+               rid: str | None = None) -> int:
         """Append one record; returns its sequence number. Scalar stores
         into preallocated storage only — no per-record allocation."""
         i = self._seq % self.capacity
@@ -154,6 +167,7 @@ class FlightRecorder:
         cols["fitted_ms"][i] = fitted_ms
         cols["val"][i] = val
         cols["spec_acc"][i] = spec_acc
+        cols["pool"][i] = pool
         self._rid[i] = rid
         seq = self._seq
         self._seq += 1
@@ -242,6 +256,12 @@ class FlightRecorder:
                 # that covered these seqs.
                 d["phase"] = ("start" if int(row["flag"]) == PROF_START
                               else "stop")
+            pool = int(row["pool"])
+            if pool:
+                # Disagg pool tag (ISSUE 13). Omitted for the unified
+                # scheduler so pre-pool snapshot consumers (and the
+                # flight-report goldens) see the exact old shape.
+                d["pool"] = POOL_NAMES.get(pool, str(pool))
             rid = self._rid[i]
             if rid:
                 d["request_id"] = rid

@@ -575,6 +575,61 @@ class GatewayMetrics:
             "gateway_engine_roofline_ratio",
             "Achieved bandwidth over the configured HBM peak.", ("engine",))
 
+        # -- disaggregated serving plane (ISSUE 13; engine/disagg.py) ---------
+        self.engine_pool_slots_total = r.gauge(
+            "gateway_engine_pool_slots_total",
+            "Batch slots owned by a scheduler pool.", ("engine", "pool"))
+        self.engine_pool_free_slots_total = r.gauge(
+            "gateway_engine_pool_free_slots_total",
+            "Free slots in a scheduler pool.", ("engine", "pool"))
+        self.engine_pool_running_total = r.gauge(
+            "gateway_engine_pool_running_total",
+            "Requests resident in a scheduler pool.", ("engine", "pool"))
+        self.engine_pool_admits_total = r.gauge(
+            "gateway_engine_pool_admits_total",
+            "Admissions placed into a scheduler pool.", ("engine", "pool"))
+        self.engine_pool_sheds_total = r.gauge(
+            "gateway_engine_pool_sheds_total",
+            "Goodput-admission sheds attributed to a pool's predicted "
+            "miss.", ("engine", "pool"))
+        self.engine_pool_predicted_ttft_seconds = r.gauge(
+            "gateway_engine_pool_predicted_ttft_seconds",
+            "Admission controller's predicted TTFT through the prefill "
+            "pool.", ("engine", "pool"))
+        self.engine_pool_predicted_tpot_seconds = r.gauge(
+            "gateway_engine_pool_predicted_tpot_seconds",
+            "Admission controller's predicted per-token time through the "
+            "decode pool.", ("engine", "pool"))
+        self.engine_pool_occupancy_ratio = r.gauge(
+            "gateway_engine_pool_occupancy_ratio",
+            "Fraction of the occupancy window spent in the pool's "
+            "dispatches (flight-ring derived).", ("engine", "pool"))
+        self.engine_disagg_handoffs_total = r.gauge(
+            "gateway_engine_disagg_handoffs_total",
+            "Prefill-to-decode KV handoffs (zero-copy refcount "
+            "transfers).", ("engine",))
+        self.engine_disagg_handoff_pages_total = r.gauge(
+            "gateway_engine_disagg_handoff_pages_total",
+            "KV pages whose ownership moved across a handoff without a "
+            "device copy.", ("engine",))
+        self.engine_disagg_clamps_total = r.gauge(
+            "gateway_engine_disagg_clamps_total",
+            "Admissions flagged TTFT-at-risk (clamped) instead of shed.",
+            ("engine",))
+        self.slo_pool_met_total = r.counter(
+            "gateway_slo_pool_met_total",
+            "SLO-met requests by the pool that served their decode.",
+            ("engine", "pool"))
+        self.slo_pool_violated_total = r.counter(
+            "gateway_slo_pool_violated_total",
+            "SLO-violating requests by the pool that served their "
+            "decode.", ("engine", "pool"))
+        self.slo_pool_goodput_ratio = r.gauge(
+            "gateway_slo_pool_goodput_ratio",
+            "Per-pool goodput: met over (met + violated) for requests "
+            "the pool decoded — the pooled-vs-unified scoreboard.",
+            ("engine", "pool"))
+
     def render(self) -> str:
         return self.registry.render()
 

@@ -184,6 +184,21 @@ class LocalProvider(Provider):
         else:
             self._metrics.slo_violated_total.labels(
                 engine=self.name, phase=outcome["phase"]).inc()
+        # Per-pool SLO attribution (ISSUE 13): keyed by the pool that
+        # served the request's decode (post-handoff), so a disaggregated
+        # engine's goodput splits into per-pool numerators and the
+        # unified engine keeps one "unified" series — the
+        # pooled-vs-unified scoreboard behind
+        # gateway_slo_pool_goodput_ratio.
+        from ..obs.flight import POOL_NAMES
+        pool = POOL_NAMES.get(getattr(req, "pool", 0), "unified")
+        outcome["pool"] = pool
+        if outcome["met"]:
+            self._metrics.slo_pool_met_total.labels(
+                engine=self.name, pool=pool).inc()
+        else:
+            self._metrics.slo_pool_violated_total.labels(
+                engine=self.name, pool=pool).inc()
         req._slo_outcome_cache = outcome
         return outcome
 

@@ -62,6 +62,24 @@ _ENGINE_GAUGES = (
     ("hbm_device_limit_bytes", "engine_hbm_device_limit_bytes", 1.0),
     ("hbm_headroom_ratio", "engine_hbm_headroom_ratio", 1.0),
     ("watermark_sheds", "engine_watermark_sheds_total", 1.0),
+    # Disaggregated serving (ISSUE 13): engine-level handoff/clamp
+    # counters; the per-pool block fans out via _POOL_GAUGES below.
+    ("disagg_handoffs", "engine_disagg_handoffs_total", 1.0),
+    ("disagg_handoff_pages", "engine_disagg_handoff_pages_total", 1.0),
+    ("disagg_clamps", "engine_disagg_clamps_total", 1.0),
+)
+
+# stats()["pools"][pool] key → GatewayMetrics attribute (plus scale),
+# one series per (engine, pool) label pair.
+_POOL_GAUGES = (
+    ("slots", "engine_pool_slots_total", 1.0),
+    ("free_slots", "engine_pool_free_slots_total", 1.0),
+    ("running", "engine_pool_running_total", 1.0),
+    ("admits", "engine_pool_admits_total", 1.0),
+    ("sheds", "engine_pool_sheds_total", 1.0),
+    ("predicted_ttft_ms", "engine_pool_predicted_ttft_seconds", 1e-3),
+    ("predicted_tpot_ms", "engine_pool_predicted_tpot_seconds", 1e-3),
+    ("occupancy_ratio", "engine_pool_occupancy_ratio", 1.0),
 )
 
 
@@ -87,6 +105,17 @@ def make_stats_collector(gw) -> "callable":
                 if isinstance(val, (int, float)):
                     getattr(metrics, attr).labels(engine=name).set(
                         val * scale)
+            pools = stats.get("pools")
+            if isinstance(pools, dict):
+                for pool_name, pstats in pools.items():
+                    if not isinstance(pstats, dict):
+                        continue
+                    for key, attr, scale in _POOL_GAUGES:
+                        val = pstats.get(key)
+                        if isinstance(val, (int, float)):
+                            getattr(metrics, attr).labels(
+                                engine=name, pool=pool_name).set(
+                                    val * scale)
             total = stats.get("total_pages")
             free = stats.get("free_pages")
             if isinstance(total, (int, float)) and total > 0 \
@@ -122,6 +151,20 @@ def make_stats_collector(gw) -> "callable":
             tot = met + violated_by_engine.get(eng, 0.0)
             if tot > 0:
                 metrics.slo_goodput_ratio.labels(engine=eng).set(met / tot)
+        # Per-pool goodput (ISSUE 13): same derivation keyed by the pool
+        # that served the request's decode — the pooled-vs-unified
+        # scoreboard the disagg A/B reads.
+        pool_met = {key: child.value
+                    for key, child in metrics.slo_pool_met_total.children()}
+        pool_violated = {
+            key: child.value
+            for key, child in metrics.slo_pool_violated_total.children()}
+        for key in set(pool_met) | set(pool_violated):
+            met = pool_met.get(key, 0.0)
+            tot = met + pool_violated.get(key, 0.0)
+            if tot > 0:
+                metrics.slo_pool_goodput_ratio.labels(
+                    engine=key[0], pool=key[1]).set(met / tot)
         metrics.trace_ring_evicted_total.set(gw.tracer.evicted_total)
         # XLA compile telemetry (ISSUE 8): process-wide monitor, one
         # series per triggering phase — a non-startup phase here is a

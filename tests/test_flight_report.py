@@ -122,6 +122,84 @@ def test_bare_records_and_bad_input():
         flight_report.convert({"nope": 1})
 
 
+POOLED_DOC = {"records": [
+    {"seq": 0, "t": 10.0, "kind": "step", "dur_ms": 8.0,
+     "step_kind": "prefill", "pool": "prefill", "prefill_chunks": 2,
+     "tokens": 1, "busy": False, "clamped": False},
+    {"seq": 1, "t": 10.02, "kind": "step", "dur_ms": 12.0,
+     "step_kind": "decode", "pool": "decode", "burst_depth": 4,
+     "tokens": 8, "busy": False, "clamped": False},
+    {"seq": 2, "t": 10.03, "kind": "step", "dur_ms": 5.0,
+     "step_kind": "decode", "burst_depth": 2, "tokens": 2,
+     "busy": False, "clamped": False},
+]}
+
+# Golden pin for the pool lanes (ISSUE 13): epoch = 9.992 s (first
+# slice start), pool-tagged steps land on their own scheduler:<pool>
+# tracks, the pool-less step keeps tid 0 — the pre-pool wire format.
+POOLED_GOLDEN = [
+    {"ph": "M", "pid": 1, "name": "process_name",
+     "args": {"name": "engine:engine"}, "ts": 0},
+    {"ph": "M", "pid": 1, "tid": 0, "name": "thread_name",
+     "args": {"name": "scheduler"}, "ts": 0},
+    {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+     "args": {"name": "lifecycle"}, "ts": 0},
+    {"ph": "X", "pid": 1, "tid": 10000, "name": "prefill", "cat": "step",
+     "ts": 0, "dur": 8000,
+     "args": {"seq": 0, "kind": "step", "dur_ms": 8.0,
+              "step_kind": "prefill", "pool": "prefill",
+              "prefill_chunks": 2, "tokens": 1, "busy": False,
+              "clamped": False}},
+    {"ph": "X", "pid": 1, "tid": 10001, "name": "decode[4]",
+     "cat": "step", "ts": 16000, "dur": 12000,
+     "args": {"seq": 1, "kind": "step", "dur_ms": 12.0,
+              "step_kind": "decode", "pool": "decode", "burst_depth": 4,
+              "tokens": 8, "busy": False, "clamped": False}},
+    {"ph": "X", "pid": 1, "tid": 0, "name": "decode[2]", "cat": "step",
+     "ts": 33000, "dur": 5000,
+     "args": {"seq": 2, "kind": "step", "dur_ms": 5.0,
+              "step_kind": "decode", "burst_depth": 2, "tokens": 2,
+              "busy": False, "clamped": False}},
+    {"ph": "M", "pid": 1, "tid": 10001, "name": "thread_name",
+     "args": {"name": "scheduler:decode"}, "ts": 0},
+    {"ph": "M", "pid": 1, "tid": 10000, "name": "thread_name",
+     "args": {"name": "scheduler:prefill"}, "ts": 0},
+]
+
+
+def test_pool_lanes_golden():
+    """ISSUE 13: pool-tagged step records get per-pool scheduler lanes
+    (scheduler:prefill / scheduler:decode) with thread metas; a pool-less
+    record in the same trace keeps the single scheduler track."""
+    out = flight_report.convert(POOLED_DOC)
+    assert out["traceEvents"] == POOLED_GOLDEN
+
+
+def test_pool_less_trace_has_no_pool_lanes():
+    """Pre-pool flight documents convert byte-identically: no pool lanes
+    appear unless a record carries a pool tag (golden pin above covers
+    the exact bytes; this guards the lane set)."""
+    out = flight_report.convert(FLIGHT_DOC)
+    tids = {e.get("tid") for e in out["traceEvents"]}
+    assert not any(isinstance(t, int) and
+                   t >= flight_report.TID_POOL_BASE for t in tids)
+
+
+def test_unknown_pool_name_gets_overflow_lane():
+    doc = {"records": [
+        {"seq": 0, "t": 1.0, "kind": "step", "dur_ms": 1.0,
+         "step_kind": "decode", "pool": "mystery", "busy": False,
+         "clamped": False}]}
+    out = flight_report.convert(doc)
+    (ev,) = [e for e in out["traceEvents"] if e["ph"] == "X"]
+    assert ev["tid"] == (flight_report.TID_POOL_BASE
+                         + len(flight_report.POOL_LANE_ORDER))
+    metas = [e for e in out["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"
+             and e["args"]["name"] == "scheduler:mystery"]
+    assert len(metas) == 1
+
+
 def test_spec_step_name_carries_accepted_tokens():
     """ISSUE 10: SPEC step records carry their accepted-draft yield and
     the converter surfaces it in the slice name (plus the full record in

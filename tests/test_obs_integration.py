@@ -78,6 +78,17 @@ class ObsGateway:
                                 # engage on CPU (ISSUE 8).
                                 "hbm_peak_gbps": 1.0,
                                 "max_tokens_default": 8}}},
+            # A second tiny engine with the two-pool disaggregated
+            # scheduler (ISSUE 13): built lazily, so only the pool tests
+            # pay for it.
+            {"tpud": {"type": "local",
+                      "engine": {"preset": "tiny-test", "dtype": "float32",
+                                 "max_batch_size": 2, "max_seq_len": 128,
+                                 "prefill_chunk": 32, "decode_burst": 4,
+                                 "kv_page_size": 16,
+                                 "max_tokens_default": 8,
+                                 "disaggregation": {"enabled": True,
+                                                    "prefill_slots": 1}}}},
         ]
         rules = [
             {"gateway_model_name": "gw/local",
@@ -91,6 +102,9 @@ class ObsGateway:
             {"gateway_model_name": "gw/local-direct",
              "fallback_models": [
                  {"provider": "tpu", "model": "tiny-test"}]},
+            {"gateway_model_name": "gw/disagg",
+             "fallback_models": [
+                 {"provider": "tpud", "model": "tiny-test"}]},
         ]
         (self.tmp_path / "providers.json").write_text(json.dumps(providers))
         (self.tmp_path / "models_fallback_rules.json").write_text(
@@ -613,6 +627,105 @@ async def test_slo_violation_attributed_queued_metrics_db_and_usage(
     probe_rows = [r for r in rows if r["slo_phase"] == "queued"]
     assert probe_rows and probe_rows[0]["slo_met"] == 0
     assert any(r["slo_met"] == 1 for r in rows)
+
+
+async def test_disagg_pool_series_and_per_pool_goodput(tmp_path,
+                                                       local_factory):
+    """ISSUE 13 observability: serving through the two-pool engine puts
+    the gateway_engine_pool_* gauges, the handoff counters, and the
+    per-pool SLO attribution (slo_pool_* + the per-pool goodput ratio —
+    the pooled-vs-unified scoreboard) into /metrics under the exposition
+    grammar. The request's usage SLO block names the pool that served
+    its decode."""
+    async with ObsGateway(tmp_path, local_factory) as g:
+        resp = await g.client.post(
+            "/v1/chat/completions",
+            json={"model": "gw/disagg", "max_tokens": 4,
+                  "messages": [{"role": "user", "content": "pools"}]},
+            headers={"x-slo-ttft-ms": "60000"})
+        assert resp.status == 200
+        slo = (await resp.json())["usage"]["slo"]
+        # Cold admission lands on the prefill pool and hands off; the
+        # decode pool owns the request by stream end.
+        assert slo["met"] is True and slo["pool"] == "decode"
+
+        resp = await g.client.get("/metrics")
+        text = await resp.text()
+
+    families = validate_prometheus_text(text)
+
+    def val(fam, **labels):
+        for name, got, value in families[fam]["samples"]:
+            if all(got.get(k) == v for k, v in labels.items()):
+                return value
+        return None
+
+    # Pool topology gauges: one prefill slot + one decode slot (B=2).
+    assert val("gateway_engine_pool_slots_total",
+               engine="tpud", pool="prefill") == 1
+    assert val("gateway_engine_pool_slots_total",
+               engine="tpud", pool="decode") == 1
+    assert val("gateway_engine_pool_admits_total",
+               engine="tpud", pool="prefill") >= 1
+    assert val("gateway_engine_pool_free_slots_total",
+               engine="tpud", pool="decode") == 1    # drained by scrape
+    assert val("gateway_engine_pool_sheds_total",
+               engine="tpud", pool="prefill") == 0
+    # The zero-copy handoff counters moved pages without copying them.
+    assert val("gateway_engine_disagg_handoffs_total", engine="tpud") >= 1
+    assert val("gateway_engine_disagg_handoff_pages_total",
+               engine="tpud") >= 1
+    # Per-pool SLO attribution → the scoreboard ratio.
+    assert val("gateway_slo_pool_met_total",
+               engine="tpud", pool="decode") >= 1
+    assert val("gateway_slo_pool_goodput_ratio",
+               engine="tpud", pool="decode") == 1.0
+    # The unified engine never grows pool-topology gauges; its SLO
+    # attribution keeps the single "unified" series (the other half of
+    # the pooled-vs-unified scoreboard), never a prefill/decode split.
+    assert all(got.get("engine") != "tpu"
+               for _, got, _ in
+               families["gateway_engine_pool_slots_total"]["samples"])
+    assert all(got.get("pool") == "unified"
+               for _, got, _ in
+               families["gateway_slo_pool_met_total"]["samples"]
+               if got.get("engine") == "tpu")
+
+
+async def test_goodput_shed_maps_to_429_with_numeric_retry_after(
+        tmp_path, local_factory):
+    """ISSUE 13 acceptance: when the decode pool's predicted TPOT misses
+    the request's target, admission sheds through the PR 3 overload path
+    — HTTP 429 with a numeric Retry-After — and the pool's shed counter
+    reaches /metrics."""
+    async with ObsGateway(tmp_path, local_factory) as g:
+        provider = await g.gw.registry.get("tpud")
+        engine = provider.engine
+        # Pin the fitted decode step time far above the ask so the
+        # predictor's verdict is deterministic (no warm-up dependence).
+        saved = engine._ema_step_ms_stats
+        engine._ema_step_ms_stats = 500.0
+        try:
+            resp = await g.client.post(
+                "/v1/chat/completions",
+                json={"model": "gw/disagg", "max_tokens": 4,
+                      "messages": [{"role": "user", "content": "shed"}]},
+                headers={"x-slo-tpot-ms": "0.01"})
+            assert resp.status == 429
+            retry_after = resp.headers.get("Retry-After")
+            assert retry_after is not None and float(retry_after) >= 1
+            body = await resp.json()
+            assert "TPOT target" in json.dumps(body)
+        finally:
+            engine._ema_step_ms_stats = saved
+
+        resp = await g.client.get("/metrics")
+        text = await resp.text()
+    families = validate_prometheus_text(text)
+    shed_samples = {got["pool"]: value for _, got, value in
+                    families["gateway_engine_pool_sheds_total"]["samples"]
+                    if got.get("engine") == "tpud"}
+    assert shed_samples.get("decode", 0) >= 1
 
 
 async def test_rule_level_slo_defaults_apply(tmp_path, local_factory):

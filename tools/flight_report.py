@@ -15,7 +15,12 @@ Tracks per engine (one trace-event process):
 * ``scheduler`` — one duration slice per step record, named by its
   composition (``decode[8]``, ``prefill``, ``mixed``…), with the full
   record (burst depth, tokens, queue depth, fitted vs measured step
-  time, clamp engagement) in ``args`` for the detail pane;
+  time, clamp engagement) in ``args`` for the detail pane. A
+  disaggregated engine (ISSUE 13) tags its step records with a ``pool``
+  name and each pool gets its OWN lane (``scheduler:prefill`` /
+  ``scheduler:decode``) so pool interference — the thing disaggregation
+  exists to remove — is visible as lane overlap; pool-less records keep
+  the single ``scheduler`` lane, byte-identical to pre-pool traces;
 * ``lifecycle`` — instant events for admissions, sheds, and prefix-cache
   evictions (request ids attached, linking back to
   ``/v1/api/trace/{id}`` via the records' ``seq`` numbers);
@@ -36,6 +41,10 @@ from typing import Any
 TID_SCHED = 0
 TID_LIFECYCLE = 1
 TID_SLOT_BASE = 2
+# Per-pool scheduler lanes (ISSUE 13): far above any real slot index so
+# slot tracks and pool tracks can never collide in one process.
+TID_POOL_BASE = 10000
+POOL_LANE_ORDER = ("prefill", "decode", "unified")
 
 
 def _step_name(rec: dict[str, Any]) -> str:
@@ -73,12 +82,21 @@ def engine_events(engine: str, records: list[dict[str, Any]],
 
     admits: dict[str, dict[str, Any]] = {}      # rid -> admit record
     slots_seen: set[int] = set()
+    pools_seen: set[str] = set()
     for rec in records:
         kind = rec.get("kind")
         dur_us = int(round(float(rec.get("dur_ms", 0.0)) * 1000.0))
         if kind == "step":
+            pool = rec.get("pool")
+            if pool:
+                tid = TID_POOL_BASE + (
+                    POOL_LANE_ORDER.index(pool)
+                    if pool in POOL_LANE_ORDER else len(POOL_LANE_ORDER))
+                pools_seen.add(str(pool))
+            else:
+                tid = TID_SCHED
             events.append({
-                "ph": "X", "pid": pid, "tid": TID_SCHED,
+                "ph": "X", "pid": pid, "tid": tid,
                 "name": _step_name(rec), "cat": "step",
                 "ts": us(rec["t"]) - dur_us, "dur": dur_us,
                 "args": {k: v for k, v in rec.items() if k != "t"},
@@ -124,6 +142,11 @@ def engine_events(engine: str, records: list[dict[str, Any]],
         if slot >= 0:
             events.append(_meta(pid, TID_SLOT_BASE + slot, "thread_name",
                                 f"slot {slot}"))
+    for pool in sorted(pools_seen):
+        tid = TID_POOL_BASE + (POOL_LANE_ORDER.index(pool)
+                               if pool in POOL_LANE_ORDER
+                               else len(POOL_LANE_ORDER))
+        events.append(_meta(pid, tid, "thread_name", f"scheduler:{pool}"))
     return events
 
 
