@@ -1125,6 +1125,210 @@ def disagg_ab_rung(args) -> dict:
     return out
 
 
+def failover_ab_rung(args) -> dict:
+    """Failover A/B (ISSUE 14 acceptance): a scripted mid-run engine kill
+    under load, through the REAL router + breaker + supervised engine.
+    Three windows are measured against one request stream: steady
+    (healthy local engine), incident (an armed FaultPlan kills the step
+    loop mid-decode; in-flight streams get in-band SSE error frames,
+    new requests fail over to a remote stub once the breaker opens), and
+    recovered (fault cleared, admin stop, cooldown, half-open probe
+    readmits the local engine). The scoreboard is the goodput ratio per
+    window — the incident window must stay NONZERO because the remote
+    arm absorbs — plus the p99 kill→error-frame latency (the PR 3
+    mid-stream contract made measurable)."""
+    import asyncio
+    import tempfile
+    from pathlib import Path
+
+    from llmapigateway_tpu.config.loader import ConfigLoader
+    from llmapigateway_tpu.db.rotation import RotationDB
+    from llmapigateway_tpu.engine.engine import FaultPlan
+    from llmapigateway_tpu.providers.base import (
+        JSONCompletion, NullUsageObserver, Provider)
+    from llmapigateway_tpu.providers.local import LocalProvider
+    from llmapigateway_tpu.reliability import BreakerRegistry
+    from llmapigateway_tpu.routing.router import Router
+
+    engine = build_engine(args, "paged", disagg=True)[0]
+    # A deliberately tiny restart budget: the armed fault keeps raising,
+    # burns it, and parks the engine "failed" — a deterministic incident
+    # plateau to measure against instead of racing backoff windows.
+    engine.supervisor.max_restarts = 2
+    engine.supervisor.backoff_ms = 10.0
+
+    class RemoteStub(Provider):
+        """The absorbing remote arm: a healthy upstream with a fixed
+        small reply latency, so backup-served goodput is attributable."""
+
+        def __init__(self):
+            self.name = "backup"
+            self.calls = 0
+
+        async def complete(self, request, observer):
+            self.calls += 1
+            await asyncio.sleep(0.002)
+            observer.on_first_token()
+            observer.on_stream_end()
+            return JSONCompletion(
+                data={"choices": [{"message": {"role": "assistant",
+                                               "content": "remote"},
+                                   "finish_reason": "stop"}]},
+                provider=self.name), None
+
+    class Registry:
+        def __init__(self, providers):
+            self.providers = providers
+
+        async def get(self, name):
+            return self.providers.get(name)
+
+    remote = RemoteStub()
+    providers = {"local_tpu": LocalProvider("local_tpu", engine),
+                 "backup": remote}
+    # Short breaker window: the steady window's successes age out during
+    # the kill/settle sleep, so the incident's first two 503s open the
+    # breaker on a clean failure rate (min_requests=2, rate 1.0).
+    WINDOW_S, COOLDOWN_S = 0.8, 0.6
+    PROVIDERS = ('[{"local_tpu": {"baseUrl": "http://127.0.0.1:1/v1", '
+                 '"apikey": "K", "breaker": {"min_requests": 2, '
+                 f'"window_s": {WINDOW_S}, "failure_threshold": 0.5, '
+                 f'"cooldown_s": {COOLDOWN_S}}}}}}},\n'
+                 ' {"backup": {"baseUrl": "http://127.0.0.1:1/v1", '
+                 '"apikey": "K"}}]')
+    RULES = ('[{"gateway_model_name": "gw/failover", "fallback_models": ['
+             '{"provider": "local_tpu", "model": "local"}, '
+             '{"provider": "backup", "model": "backup-model"}]}]')
+
+    def observer_factory(provider, model):
+        return NullUsageObserver()
+
+    async def dispatch(router, stream=False, max_tokens=8):
+        payload = {"model": "gw/failover",
+                   "messages": [{"role": "user", "content": "bench"}],
+                   "max_tokens": max_tokens, "temperature": 0.0}
+        if stream:
+            payload["stream"] = True
+        t0 = time.monotonic()
+        out = await router.dispatch(payload, "bench-key", observer_factory)
+        return out, 1000.0 * (time.monotonic() - t0)
+
+    async def probe_window(router, n, max_tokens=8):
+        ok, latencies, served = 0, [], {}
+        for _ in range(n):
+            out, ms = await dispatch(router, max_tokens=max_tokens)
+            latencies.append(ms)
+            if out.result is not None:
+                ok += 1
+                served[out.provider] = served.get(out.provider, 0) + 1
+        latencies.sort()
+        return {"requests": n, "ok": ok,
+                "goodput_ratio": round(ok / n, 3), "served": served,
+                "p50_ms": round(latencies[n // 2], 2)}
+
+    async def run():
+        with tempfile.TemporaryDirectory() as td:
+            tmp = Path(td)
+            (tmp / "providers.json").write_text(PROVIDERS)
+            (tmp / "models_fallback_rules.json").write_text(RULES)
+            loader = ConfigLoader(tmp, fallback_provider="backup")
+            router = Router(loader, Registry(providers),
+                            RotationDB(tmp / "rotdb"),
+                            fallback_provider="backup",
+                            breakers=BreakerRegistry(loader))
+            await engine.start()
+
+            # -- steady window: healthy local engine serves everything.
+            steady = await probe_window(router, 8)
+
+            # -- victims: streams to be killed mid-decode. Dispatched
+            # concurrently — on a tiny pool some queue behind the first;
+            # the kill is armed as soon as ONE stream commits, so at
+            # least one in-band error frame is guaranteed, and the
+            # still-queued victims are failed over (or error-framed)
+            # instead of serializing the incident.
+            victim_tasks = [
+                asyncio.create_task(dispatch(router, stream=True,
+                                             max_tokens=64))
+                for _ in range(3)]
+            while not any(t.done() for t in victim_tasks):
+                await asyncio.sleep(0.005)
+            await asyncio.sleep(0.02)       # let the stream decode a bit
+            # -- the kill: every step from here raises; the supervisor
+            # retries (restart #1, #2), burns the budget, parks "failed".
+            t_kill = time.monotonic()
+            engine.fault_plan = FaultPlan(
+                fail_step_after=0, fail_step_msg="bench: injected kill")
+            victim_outs = [o for o, _ in
+                           await asyncio.gather(*victim_tasks)]
+            committed = [o.result.frames for o in victim_outs
+                         if o.result is not None
+                         and hasattr(o.result, "frames")]
+            absorbed = sum(1 for o in victim_outs
+                           if o.result is not None and
+                           o.provider == "backup")
+            error_frame_ms: list = []
+
+            async def watch(frames):
+                async for frame in frames:
+                    if b'"error"' in frame:
+                        error_frame_ms.append(
+                            1000.0 * (time.monotonic() - t_kill))
+                        return
+
+            await asyncio.wait_for(
+                asyncio.gather(*(watch(f) for f in committed)), timeout=30)
+            # Age the steady successes out of the breaker window so the
+            # incident failure rate is clean.
+            await asyncio.sleep(WINDOW_S + 0.1)
+
+            incident = await probe_window(router, 8)
+            kill_ms = sorted(error_frame_ms)
+            incident["killed_streams"] = len(committed)
+            incident["victims_failed_over"] = absorbed
+            incident["error_frames"] = len(kill_ms)
+            if kill_ms:
+                incident["p99_error_frame_ms"] = round(
+                    kill_ms[min(len(kill_ms) - 1,
+                                int(0.99 * len(kill_ms)))], 2)
+            # Goodput over the whole window: killed streams count against
+            # it, failed-over victims count for it (the remote absorbed).
+            total = incident["requests"] + len(victim_outs)
+            incident["goodput_ratio"] = round(
+                (incident["ok"] + absorbed) / total, 3)
+            incident["engine_state"] = engine.supervisor.state
+
+            # -- recovery: clear the fault, admin-stop the parked engine
+            # (failed→stopped re-arms auto-start), let the breaker cool
+            # down, then let the half-open probe readmit local serving.
+            engine.fault_plan = None
+            await engine.stop()
+            await asyncio.sleep(COOLDOWN_S + 0.1)
+            recovered = await probe_window(router, 6)
+            stats = engine.stats()
+            await engine.stop()
+            return steady, incident, recovered, stats
+
+    steady, incident, recovered, stats = asyncio.run(run())
+    return {
+        "workload": {"probe_max_tokens": 8, "victims": 3,
+                     "victim_max_tokens": 64},
+        "breaker": {"min_requests": 2, "window_s": WINDOW_S,
+                    "failure_threshold": 0.5, "cooldown_s": COOLDOWN_S},
+        "steady": steady,
+        "incident": incident,
+        "recovered": recovered,
+        "remote_calls": remote.calls,
+        "supervisor": {
+            "restarts_total": stats.get("supervisor_restarts_total"),
+            "last_failure_kind": stats.get("supervisor_last_failure_kind"),
+            "final_state": stats.get("supervisor_state"),
+            "flight_admits": stats.get("flight_admits"),
+            "flight_finishes": stats.get("flight_finishes"),
+        },
+    }
+
+
 def attention_inmodel_ab(args) -> dict:
     """In-model attention A/B: the full greedy fused-scan decode step with
     the Pallas flash attention vs the jnp reference path, on real
@@ -1353,6 +1557,12 @@ def main() -> None:
                          "disaggregation A/B workload")
     ap.add_argument("--disagg-ab-repeats", type=int, default=3,
                     help="alternating disagg-A/B paired rounds per arm")
+    ap.add_argument("--failover-ab", type=int, default=1,
+                    help="engine-supervision failover A/B through the "
+                         "real router+breaker: scripted mid-run engine "
+                         "kill, goodput per steady/incident/recovered "
+                         "window + p99 kill-to-error-frame latency "
+                         "(0 disables; publishes BENCH_FAILOVER_r14)")
     ap.add_argument("--ttft-probe-child", action="store_true",
                     help=argparse.SUPPRESS)
     ap.add_argument("--max-seconds", type=float, default=1200.0,
@@ -2169,6 +2379,23 @@ def main() -> None:
         except Exception as e:
             errors.append(f"disagg_ab: {e!r}")
             note(f"FAILED disagg A/B phase: {e!r}")
+        finally:
+            engine = None
+
+    # -- phase 4l: engine-supervision failover A/B (ISSUE 14) ----------------
+    if args.failover_ab and not over_budget("failover_ab"):
+        try:
+            engine = None
+            extra["failover_ab"] = failover_ab_rung(args)
+            fo = extra["failover_ab"]
+            note(f"failover A/B: goodput steady "
+                 f"{fo['steady']['goodput_ratio']} / incident "
+                 f"{fo['incident']['goodput_ratio']} / recovered "
+                 f"{fo['recovered']['goodput_ratio']}, p99 error frame "
+                 f"{fo['incident'].get('p99_error_frame_ms')} ms")
+        except Exception as e:
+            errors.append(f"failover_ab: {e!r}")
+            note(f"FAILED failover A/B phase: {e!r}")
         finally:
             engine = None
 

@@ -12,6 +12,7 @@ from ..core import Rule
 from .async_blocking import RULE as ASYNC_BLOCKING
 from .device_sync import RULE as DEVICE_SYNC
 from .exception_hygiene import RULE as EXCEPTION_HYGIENE
+from .lifecycle_discipline import RULE as LIFECYCLE_DISCIPLINE
 from .lock_discipline import RULE as LOCK_DISCIPLINE
 from .metric_discipline import RULE as METRIC_DISCIPLINE
 from .secret_hygiene import RULE as SECRET_HYGIENE
@@ -23,6 +24,7 @@ ALL_RULES: tuple[Rule, ...] = (
     ASYNC_BLOCKING,
     TRACER_HAZARD,
     LOCK_DISCIPLINE,
+    LIFECYCLE_DISCIPLINE,
     SECRET_HYGIENE,
     SSE_PROTOCOL,
     TIMEOUT_DISCIPLINE,

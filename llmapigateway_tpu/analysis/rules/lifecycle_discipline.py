@@ -1,0 +1,77 @@
+"""lifecycle-discipline: engine lifecycle state changes only through the
+state-machine API (ISSUE 14).
+
+The engine supervisor's ``_lc_state`` attribute is the single source of
+truth for "what state is this engine in" — serving, draining,
+restarting, failed. Every consumer (admission gate, watchdog, breaker
+failover, /metrics, flight records) keys off it, and the transition
+table in ``reliability/supervisor.py`` is what makes illegal edges
+(``failed → serving`` without a stop) impossible.
+
+A direct write — ``engine.supervisor._lc_state = "serving"`` in a
+recovery path, or ``setattr(sup, "_lc_state", ...)`` in a test helper —
+bypasses the table, the transition history, the flight-ring echo, and
+the drain bookkeeping at once: the engine would *be* in a state it
+never *entered*. This rule pins all ``_lc_state`` stores to the
+supervisor module itself (where ``__init__`` seeds it and
+``transition()`` validates every edge); everyone else must call
+``transition()``.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..core import Finding, Rule
+from ._util import call_name
+
+# The one attribute the supervisor state machine owns, and the one
+# module allowed to store to it.
+_STATE_ATTR = "_lc_state"
+_OWNER_MODULE = "reliability/supervisor.py"
+
+
+class LifecycleDisciplineRule(Rule):
+    name = "lifecycle-discipline"
+    description = ("engine lifecycle state (`_lc_state`) may only be "
+                   "written inside reliability/supervisor.py — every "
+                   "other module must go through "
+                   "EngineSupervisor.transition()")
+
+    def check(self, tree: ast.Module, source: str,
+              relpath: str) -> list[Finding]:
+        if relpath.endswith(_OWNER_MODULE):
+            return []
+        findings: list[Finding] = []
+        for node in ast.walk(tree):
+            targets: list[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+                targets = [node.target]
+            elif isinstance(node, ast.Call):
+                # setattr(sup, "_lc_state", ...) is the same store in a
+                # trench coat.
+                if (call_name(node) == "setattr" and len(node.args) >= 2
+                        and isinstance(node.args[1], ast.Constant)
+                        and node.args[1].value == _STATE_ATTR):
+                    findings.append(self.finding(
+                        relpath, node,
+                        "setattr on '_lc_state' bypasses the lifecycle "
+                        "state machine — use "
+                        "EngineSupervisor.transition()"))
+                continue
+            else:
+                continue
+            for tgt in targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and tgt.attr == _STATE_ATTR):
+                    findings.append(self.finding(
+                        relpath, node,
+                        "direct write to '_lc_state' bypasses the "
+                        "lifecycle state machine (transition table, "
+                        "history, flight-ring echo) — use "
+                        "EngineSupervisor.transition()"))
+        return findings
+
+
+RULE = LifecycleDisciplineRule()

@@ -649,4 +649,9 @@ def default_instrumented_classes() -> list[type]:
     from ..engine.disagg import DisaggController, SlotPool
     classes.append(DisaggController)
     classes.append(SlotPool)
+    # The engine supervisor (ISSUE 14) is lifecycle state with the same
+    # loop-thread-only contract: transitions, heartbeats and restart
+    # bookkeeping all happen scheduler-side. jax-free module.
+    from ..reliability.supervisor import EngineSupervisor
+    classes.append(EngineSupervisor)
     return classes

@@ -52,6 +52,31 @@ class DisaggregationConfig(BaseModel):
         return v
 
 
+class SupervisorConfig(BaseModel):
+    """Engine supervision knobs (reliability/supervisor.py, ISSUE 14).
+
+    The scheduler loop stamps a heartbeat every step; a watchdog task
+    declares the engine stalled when the heartbeat goes stale past
+    ``watchdog_ms`` while work is pending, and triggers the same
+    supervised restart path as a step-loop crash: bounded exponential
+    backoff (``backoff_ms`` doubling per attempt up to
+    ``backoff_max_ms``), at most ``max_restarts`` attempts before the
+    engine parks in ``failed`` and traffic stays on the router's
+    fallback chain. ``drain_deadline_ms`` bounds how long an
+    administrative drain waits for in-flight decodes before
+    force-cancelling stragglers.
+    """
+    model_config = ConfigDict(extra="forbid")
+
+    # 0 disables the watchdog task entirely (heartbeats still stamp, so
+    # stats()/health report staleness either way).
+    watchdog_ms: float = Field(default=0.0, ge=0.0)
+    max_restarts: int = Field(default=3, ge=0)
+    backoff_ms: float = Field(default=50.0, ge=0.0)
+    backoff_max_ms: float = Field(default=5000.0, ge=0.0)
+    drain_deadline_ms: float = Field(default=10000.0, gt=0.0)
+
+
 class LocalEngineConfig(BaseModel):
     """Engine settings for a ``type: local`` provider entry.
 
@@ -252,6 +277,10 @@ class LocalEngineConfig(BaseModel):
     # the unified scheduler is byte-identical to pre-pool behavior.
     disaggregation: DisaggregationConfig = Field(
         default_factory=DisaggregationConfig)
+    # Engine supervision (ISSUE 14): crash/stall recovery with bounded
+    # backoff, graceful drain. Watchdog defaults off; crash recovery and
+    # the lifecycle state machine are always on.
+    supervisor: SupervisorConfig = Field(default_factory=SupervisorConfig)
 
 
 class BreakerSettings(BaseModel):

@@ -92,6 +92,17 @@ class EngineOverloaded(Exception):
     gateway falls back to the next provider in the chain."""
 
 
+class EngineUnavailable(Exception):
+    """Admission refused because the engine is draining, restarting, or
+    failed (ISSUE 14). Maps to a retryable 503 in providers/local.py so
+    the breaker opens and the router fails over to remote providers
+    while the supervisor recovers the engine."""
+
+    def __init__(self, message: str, retry_after_s: float | None = None):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
 @dataclass
 class FaultPlan:
     """Injectable engine faults (SURVEY.md §5 "failure detection / fault
@@ -102,8 +113,24 @@ class FaultPlan:
     fail_prefill_after: int = -1    # raise after N prefill chunks (-1 = off)
     fail_decode_after: int = -1     # raise after N decode bursts (-1 = off)
     slow_decode_s: float = 0.0      # added latency per decode burst
+    # Supervision chaos hooks (ISSUE 14). fail_step_after raises at the
+    # TOP of scheduler iteration N (before any admission/dispatch) with
+    # fail_step_msg — put "RESOURCE_EXHAUSTED" in the message to fake an
+    # HBM OOM (classified transient), or set fail_step_fatal to force
+    # the fatal (no-restart) classification. fail_handoff_after raises
+    # inside the disagg prefill→decode KV handoff. stall_step_after
+    # freezes iteration N for stall_s WITHOUT raising — the silent-stall
+    # shape only the watchdog can catch.
+    fail_step_after: int = -1
+    fail_step_fatal: bool = False
+    fail_step_msg: str = "injected step fault"
+    fail_handoff_after: int = -1
+    stall_step_after: int = -1
+    stall_s: float = 0.0
     prefill_calls: int = 0
     decode_calls: int = 0
+    step_calls: int = 0
+    handoff_calls: int = 0
 
     def on_prefill(self) -> None:
         self.prefill_calls += 1
@@ -116,6 +143,23 @@ class FaultPlan:
             time.sleep(self.slow_decode_s)
         if 0 <= self.fail_decode_after < self.decode_calls:
             raise RuntimeError("injected decode fault")
+
+    def on_step(self) -> float:
+        """Called at the top of every scheduler iteration. Returns the
+        stall duration to sleep (0 = none); raises for step faults."""
+        self.step_calls += 1
+        if 0 <= self.fail_step_after < self.step_calls:
+            if self.fail_step_fatal:
+                raise ValueError(self.fail_step_msg)
+            raise RuntimeError(self.fail_step_msg)
+        if 0 <= self.stall_step_after < self.step_calls:
+            return self.stall_s
+        return 0.0
+
+    def on_handoff(self) -> None:
+        self.handoff_calls += 1
+        if 0 <= self.fail_handoff_after < self.handoff_calls:
+            raise RuntimeError("injected handoff fault")
 
 
 @dataclass
@@ -491,6 +535,32 @@ class InferenceEngine:
         self.kernels = KernelRegistry()
         self.ledger: HbmLedger = self._build_ledger()
         self._watermark_sheds = 0                       # guarded-by: loop
+        # Engine supervision (ISSUE 14): lifecycle state machine +
+        # heartbeat/watchdog/backoff bookkeeping. Transitions echo into
+        # the flight ring as SUPERVISOR records so an incident reads off
+        # the same timeline as the steps it interrupted.
+        from ..reliability.supervisor import EngineSupervisor
+        sup = engine_cfg.supervisor
+        self.supervisor = EngineSupervisor(
+            watchdog_ms=sup.watchdog_ms, max_restarts=sup.max_restarts,
+            backoff_ms=sup.backoff_ms, backoff_max_ms=sup.backoff_max_ms,
+            drain_deadline_ms=sup.drain_deadline_ms,
+            on_transition=self._on_lifecycle_transition)
+        self._watchdog_task: asyncio.Task | None = None
+        self._clean_steps = 0                           # guarded-by: loop
+
+    def _on_lifecycle_transition(self, frm: str, to: str,
+                                 reason: str) -> None:
+        """Supervisor transition hook: mirror the lifecycle edge into
+        the flight ring (kind SUPERVISOR, flag = state entered)."""
+        if self.flight is None:
+            return
+        from ..obs.flight import SUPERVISOR, SUPERVISOR_STATES
+        try:
+            idx = SUPERVISOR_STATES.index(to)
+        except ValueError:
+            idx = 0
+        self.flight.record(SUPERVISOR, flag=idx, rid=reason or frm)
 
     # -- initialization ------------------------------------------------------
     def _init_params(self) -> None:
@@ -1307,6 +1377,10 @@ class InferenceEngine:
             raise RuntimeError(
                 "multihost engine is terminal after stop(); restart the "
                 "whole fleet to serve again")
+        if self.supervisor.state == "failed":
+            raise EngineUnavailable(
+                "engine is failed (restart budget exhausted or fatal "
+                "fault); traffic stays on the fallback chain")
         if self._loop_task is None:
             self._stopped = False        # restartable after stop()
             self._enable_debug_nans()
@@ -1322,6 +1396,11 @@ class InferenceEngine:
                 self._work_event = asyncio.Event()
                 self._loop = loop
             self._loop_task = loop.create_task(self._run_loop())
+            if (self.supervisor.watchdog_ms > 0
+                    and (self._watchdog_task is None
+                         or self._watchdog_task.done())):
+                self._watchdog_task = loop.create_task(
+                    self._watchdog_loop())
         if (self._warm_thread is None and self.cfg.prewarm_sampler_variants
                 and jax.default_backend() == "tpu"):
             # Pre-lower+compile BOTH sampler variants into the persistent
@@ -1335,6 +1414,13 @@ class InferenceEngine:
 
     async def stop(self) -> None:
         self._stopped = True
+        if self._watchdog_task is not None:
+            self._watchdog_task.cancel()
+            try:
+                await self._watchdog_task
+            except asyncio.CancelledError:
+                pass
+            self._watchdog_task = None
         self._work_event.set()
         if self._loop_task is not None:
             await self._loop_task
@@ -1357,9 +1443,18 @@ class InferenceEngine:
         while not self._queue.empty():
             req = self._queue.get_nowait()
             req.out_queue.put_nowait(Delta(error="engine stopped"))
+        self.supervisor.transition("stopped", "stop() requested")
 
     async def submit(self, req: GenRequest) -> None:
-        """Admit a request; raises EngineOverloaded when the queue is full."""
+        """Admit a request; raises EngineOverloaded when the queue is
+        full, EngineUnavailable while the supervisor has the engine
+        draining/restarting/failed (the router fails over)."""
+        if not self.supervisor.is_accepting():
+            state = self.supervisor.state
+            raise EngineUnavailable(
+                f"engine is {state}",
+                retry_after_s=(self.supervisor.backoff_s()
+                               if state == "restarting" else None))
         max_prompt = self.S - 1 - self.spec_k
         if len(req.prompt_ids) > max_prompt:
             raise EngineOverloaded(
@@ -1407,6 +1502,11 @@ class InferenceEngine:
             raise EngineOverloaded("engine admission queue is full") from None
         await self.start()
         self._work_event.set()
+        # Re-stamp the heartbeat at admission: an engine that idled past
+        # the watchdog deadline is NOT stalled — the deadline must start
+        # from this wake-up, not from the last step before the idle gap.
+        self.supervisor.heartbeat(self.flight.seq
+                                  if self.flight is not None else 0)
 
     def _free_slot_count(self) -> int:
         """Free slots across every pool (ONE pool unified, two disagg)."""
@@ -1449,6 +1549,9 @@ class InferenceEngine:
     # -- the batching loop ---------------------------------------------------
     async def _run_loop(self) -> None:
         logger.info("engine loop started (B=%d, S=%d)", self.B, self.S)
+        sup = self.supervisor
+        sup.transition("serving", "scheduler loop started")
+        sup.heartbeat(self.flight.seq if self.flight is not None else 0)
         while not self._stopped:
             # Clear BEFORE stepping: a submit() that lands during the await
             # inside _step sets the event and must not be wiped afterwards
@@ -1456,41 +1559,226 @@ class InferenceEngine:
             self._work_event.clear()
             try:
                 progressed = await self._step()
+                # Heartbeat AFTER the step returns (piggybacked on the
+                # flight seq): a stuck _step leaves the heartbeat stale,
+                # which is exactly what the watchdog needs to see.
+                sup.heartbeat(self.flight.seq if self.flight is not None
+                              else 0)
+                if progressed:
+                    self._clean_steps += 1
+                    if self._clean_steps == 50:
+                        # A sustained healthy stretch re-earns the full
+                        # restart budget — one crash per day must not
+                        # accumulate into "budget exhausted" forever.
+                        sup.reset_restarts()
+            except asyncio.CancelledError:
+                # Watchdog kill path: the canceller owns recovery.
+                raise
             except Exception as e:           # engine must never die silently
                 logger.exception("engine step failed")
-                for req in list(self._running.values()):
-                    req.out_queue.put_nowait(Delta(error=f"engine failure: {e}"))
-                    self._release(req)
-                if self._bridge.enabled:
-                    # Multihost: a local re-init would silently desync the
-                    # followers' cache shards (they saw no failure) and
-                    # every later SPMD call would compute garbage. The only
-                    # safe recovery is fleet shutdown; the gateway's
-                    # fallback chain takes over (provider error → remote).
-                    logger.error("multihost engine failure is fatal: "
-                                 "shutting the fleet down")
-                    self._stopped = True
-                    # Safe here: the failed burst's own broadcast completed
-                    # before its execution raised, and no other publisher
-                    # runs concurrently with this handler.
-                    await asyncio.to_thread(self._bridge.publish_shutdown)
-                    progressed = True
-                    continue
-                # donate_argnums may have consumed the cache buffer before
-                # the failure: rebuild device state so the engine recovers
-                # instead of failing every subsequent step on a deleted array.
-                try:
-                    self._init_state()
-                    for pool in self._pools:
-                        pool.reset_free()
-                    self._running.clear()
-                    self._prefilling.clear()
-                except Exception:
-                    logger.exception("engine state re-init failed")
+                from ..reliability.supervisor import EngineFailure
+                await self._on_step_failure(EngineFailure.classify(e))
                 progressed = True
             if not progressed:
                 await self._work_event.wait()
+                sup.heartbeat(self.flight.seq if self.flight is not None
+                              else 0)
         logger.info("engine loop stopped")
+
+    async def _on_step_failure(self, failure) -> None:
+        """Supervised recovery from a classified step-loop failure
+        (ISSUE 14). In-flight streams get an in-band error delta (the
+        PR 3 mid-stream contract — providers/local.py turns it into a
+        well-formed SSE error frame and partial usage records
+        downstream); queued-but-unstarted admissions stay queued for the
+        restarted engine, or are flushed with errors when the engine
+        parks in `failed` (the router's fallback chain takes over either
+        way, via EngineUnavailable at admission)."""
+        sup = self.supervisor
+        logger.error("engine failure (%s): %s", failure.kind, failure)
+        sup.note_failure(failure)
+        self._clean_steps = 0
+        # _prefilling is a secondary index into _running (admission adds
+        # to both), so flushing _running covers mid-prefill requests.
+        for req in list(self._running.values()):
+            req.out_queue.put_nowait(
+                Delta(error=f"engine failure: {failure}"))
+            self._release(req)
+        if self._bridge.enabled:
+            # Multihost: a local re-init would silently desync the
+            # followers' cache shards (they saw no failure) and every
+            # later SPMD call would compute garbage. The only safe
+            # recovery is fleet shutdown; the gateway's fallback chain
+            # takes over (provider error → remote).
+            logger.error("multihost engine failure is fatal: "
+                         "shutting the fleet down")
+            sup.transition("failed", f"multihost {failure.kind} failure")
+            self._stopped = True
+            self._fail_queued(f"engine failure: {failure}")
+            # Safe here: the failed burst's own broadcast completed
+            # before its execution raised, and no other publisher runs
+            # concurrently with this handler.
+            await asyncio.to_thread(self._bridge.publish_shutdown)
+            return
+        if failure.kind == "fatal" or not sup.can_restart():
+            reason = ("fatal failure (restart would loop on it)"
+                      if failure.kind == "fatal" else
+                      f"restart budget exhausted "
+                      f"({sup.max_restarts} attempts)")
+            logger.error("engine parked in failed state: %s", reason)
+            sup.transition("failed", reason)
+            self._stopped = True
+            self._fail_queued(f"engine failed: {failure}")
+            return
+        sup.transition("restarting", f"{failure.kind}: {failure}")
+        backoff = sup.backoff_s()
+        sup.note_restart()
+        if backoff > 0:
+            await asyncio.sleep(backoff)
+        try:
+            self._rebuild_state()
+            sup.transition("serving", "supervised restart complete")
+        except Exception:
+            logger.exception("engine state re-init failed")
+            sup.transition("failed", "restart re-init failed")
+            self._stopped = True
+            self._fail_queued("engine failed: restart re-init failed")
+
+    def _fail_queued(self, msg: str) -> None:
+        """Flush queued-but-unstarted admissions with terminal errors —
+        only on the no-recovery paths (failed / multihost shutdown)."""
+        if self._head is not None:
+            self._head.out_queue.put_nowait(Delta(error=msg))
+            self._head = None
+        while not self._queue.empty():
+            req = self._queue.get_nowait()
+            req.out_queue.put_nowait(Delta(error=msg))
+
+    def _rebuild_state(self) -> None:
+        """Tear down and rebuild device + scheduler state for a
+        supervised restart. Ordering matters: the compile monitor
+        re-arms FIRST so the rebuild's own compiles are attributed
+        instead of lost (PR 8's install-before-compile bug class, same
+        shape as PR 7's `_work_event` rebinding)."""
+        from ..obs.device import install_compile_monitor
+        install_compile_monitor()
+        # donate_argnums may have consumed the cache buffer before the
+        # failure: rebuild device state so the engine recovers instead
+        # of failing every subsequent step on a deleted array. The radix
+        # prefix cache restarts empty — its KV pages died with the pool,
+        # so "re-seed" is organic re-warming, not resurrection.
+        self._init_state()
+        for pool in self._pools:
+            pool.reset_free()
+        self._running.clear()
+        self._prefilling.clear()
+        # The ledger's tracked buffers were donated/freed with the old
+        # cache; rebuild it against the new buffers so /metrics doesn't
+        # reconcile against ghosts (restart-recovery gap, ISSUE 14).
+        self.ledger = self._build_ledger()
+
+    async def _watchdog_loop(self) -> None:
+        """Stall detector (ISSUE 14): when the scheduler heartbeat goes
+        stale past `watchdog_ms` WHILE work is pending, cancel the
+        scheduler task and route the stall through the same supervised
+        restart path as a crash. An idle engine parked on its work
+        event never trips it."""
+        sup = self.supervisor
+        from ..reliability.supervisor import EngineFailure
+        while not self._stopped:
+            # Recomputed each tick (capped at 250 ms) so watchdog_ms can
+            # be tuned on a live engine without restarting the task.
+            await asyncio.sleep(min(0.25, max(0.005,
+                                              sup.watchdog_ms / 4000.0)))
+            if self._stopped or sup.state != "serving":
+                continue
+            busy = bool(self._running or self._prefilling
+                        or self._head is not None
+                        or not self._queue.empty())
+            if not sup.is_stalled(busy):
+                continue
+            age_ms = sup.heartbeat_age_s() * 1000.0
+            logger.error("watchdog: engine stalled (heartbeat %.0f ms "
+                         "past the %.0f ms deadline)", age_ms,
+                         sup.watchdog_ms)
+            task = self._loop_task
+            if task is not None and not task.done():
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+                except Exception:
+                    logger.exception("stalled loop died on cancel")
+            self._loop_task = None
+            await self._on_step_failure(EngineFailure(
+                f"scheduler loop stalled: heartbeat {age_ms:.0f} ms past "
+                f"the {sup.watchdog_ms:.0f} ms watchdog", kind="stall"))
+            if not self._stopped:
+                loop = asyncio.get_running_loop()
+                self._loop_task = loop.create_task(self._run_loop())
+
+    async def drain(self, *, restart: bool = False,
+                    deadline_s: float | None = None) -> dict[str, Any]:
+        """Administrative drain (ISSUE 14): stop admissions, let
+        in-flight work finish under a bounded deadline, force-cancel
+        stragglers past it, then either restart the engine in place
+        (config hot-reload / planned maintenance) or stop it (SIGTERM).
+        Returns a summary for the admin caller."""
+        sup = self.supervisor
+        sup.transition("draining", "administrative drain")
+        limit = (sup.drain_deadline_ms / 1000.0
+                 if deadline_s is None else deadline_s)
+        t0 = time.monotonic()
+        forced = 0
+        while (self._running or self._head is not None
+               or not self._queue.empty()):
+            if time.monotonic() - t0 > limit:
+                # Deadline expired: force-cancel stragglers. The
+                # scheduler's cancel path frees slots but emits no
+                # terminal delta (its client-gone semantics) — a drain's
+                # clients are still connected, so the terminal frame is
+                # emitted HERE; queued requests get terminal errors
+                # directly (they never started).
+                for req in list(self._running.values()):
+                    req.cancelled = True
+                    req.out_queue.put_nowait(
+                        Delta(finish_reason="cancelled"))
+                    forced += 1
+                if self._head is not None:
+                    self._head.cancelled = True
+                    self._head.out_queue.put_nowait(
+                        Delta(finish_reason="cancelled"))
+                    forced += 1
+                while not self._queue.empty():
+                    req = self._queue.get_nowait()
+                    req.out_queue.put_nowait(
+                        Delta(error="engine draining"))
+                    forced += 1
+                self._work_event.set()
+                t1 = time.monotonic()
+                while self._running and time.monotonic() - t1 < 2.0:
+                    await asyncio.sleep(0.01)
+                break
+            self._work_event.set()
+            await asyncio.sleep(0.01)
+        summary = {"forced_cancel": forced,
+                   "drain_s": round(time.monotonic() - t0, 3)}
+        if restart:
+            sup.transition("restarting", "planned restart")
+            self._stopped = True
+            self._work_event.set()
+            if self._loop_task is not None:
+                await self._loop_task
+                self._loop_task = None
+            self._rebuild_state()
+            self._stopped = False
+            sup.transition("serving", "planned restart complete")
+            summary["restarted"] = True
+        else:
+            await self.stop()
+            summary["restarted"] = False
+        return summary
 
     async def _step(self) -> bool:
         """One scheduler iteration. Emission always happens here, on the
@@ -1501,6 +1789,12 @@ class InferenceEngine:
         (composition, burst depth, tokens, fitted-vs-measured step time)
         plus lifecycle records for admissions/evictions it performed —
         appended loop-side only, after the worker-thread awaits return."""
+        if self.fault_plan is not None:
+            stall_s = self.fault_plan.on_step()
+            if stall_s > 0:
+                # Injected silent stall: the loop stays alive but stops
+                # stepping — the failure shape only the watchdog sees.
+                await asyncio.sleep(stall_s)
         fl = self.flight
         t_step0 = fl.clock() if fl is not None else 0.0
         clamps0 = self._busy_clamps
@@ -3179,6 +3473,8 @@ class InferenceEngine:
         either slot: lag-one ``_pending`` snapshots predate the move and
         mask both rows to -1."""
         from ..obs.flight import POOL_DECODE, POOL_PREFILL
+        if self.fault_plan is not None:
+            self.fault_plan.on_handoff()
         if req.disagg_clamped:
             self._disagg.clamp_release(req)
         if req.pool != POOL_PREFILL:
@@ -3333,6 +3629,9 @@ class InferenceEngine:
             "max_seq_len": self.S,
             "kv_layout": self.cfg.kv_layout,
         }
+        # Supervisor block (ISSUE 14): lifecycle state, restart budget,
+        # heartbeat age, recent transitions — the incident story.
+        out.update(self.supervisor.stats())
         if self._disagg is not None:
             out["pools"] = self._disagg.stats()
             out["disagg_handoffs"] = self._disagg.handoffs

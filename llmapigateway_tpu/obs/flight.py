@@ -48,9 +48,17 @@ FINISH = 3        # request left its slot (any reason, incl. cancel)
 SHED = 4          # admission refused on a full queue (gateway 429 path)
 EVICT = 5         # prefix-cache eviction under page pressure
 PROF = 6          # profiler capture start/stop (ISSUE 8): rid = trace dir
+SUPERVISOR = 7    # engine lifecycle transition (ISSUE 14): flag = state
 
 KIND_NAMES = {STEP: "step", ADMIT: "admit", FINISH: "finish",
-              SHED: "shed", EVICT: "evict", PROF: "profile"}
+              SHED: "shed", EVICT: "evict", PROF: "profile",
+              SUPERVISOR: "supervisor"}
+
+# SUPERVISOR flag values: index into this tuple = the state entered.
+# Mirrors reliability/supervisor.py LIFECYCLE_STATES (order matters —
+# the flight-report goldens pin the rendered names).
+SUPERVISOR_STATES = ("starting", "serving", "draining", "restarting",
+                     "failed", "stopped")
 
 # PROF flag values (capture lifecycle).
 PROF_START = 1
@@ -256,6 +264,13 @@ class FlightRecorder:
                 # that covered these seqs.
                 d["phase"] = ("start" if int(row["flag"]) == PROF_START
                               else "stop")
+            elif kind == SUPERVISOR:
+                # Engine lifecycle transition (ISSUE 14): the state the
+                # engine ENTERED; rid carries the transition reason so
+                # an incident reads off the ring without joining logs.
+                flag = int(row["flag"])
+                d["state"] = (SUPERVISOR_STATES[flag]
+                              if flag < len(SUPERVISOR_STATES) else "?")
             pool = int(row["pool"])
             if pool:
                 # Disagg pool tag (ISSUE 13). Omitted for the unified
@@ -264,7 +279,10 @@ class FlightRecorder:
                 d["pool"] = POOL_NAMES.get(pool, str(pool))
             rid = self._rid[i]
             if rid:
-                d["request_id"] = rid
+                # The rid slot is kind-polymorphic: SUPERVISOR records
+                # store the transition reason there (no request owns a
+                # lifecycle event).
+                d["reason" if kind == SUPERVISOR else "request_id"] = rid
             out.append(d)
         return out
 

@@ -477,6 +477,37 @@ class GatewayMetrics:
         self.engine_flight_ring_evicted_total = r.gauge(
             "gateway_engine_flight_ring_evicted_total",
             "Flight-recorder records lost to ring wrap.", ("engine",))
+        # Engine supervision (ISSUE 14): lifecycle + restart telemetry.
+        self.engine_supervisor_state_ratio = r.gauge(
+            "gateway_engine_supervisor_state_ratio",
+            "Engine lifecycle state: 0 serving, 0.25 starting, 0.5 "
+            "draining, 0.75 restarting, 0.9 stopped, 1 failed.",
+            ("engine",))
+        self.engine_supervisor_restarts_total = r.gauge(
+            "gateway_engine_supervisor_restarts_total",
+            "Supervised engine restarts since the last healthy stretch "
+            "(resets after sustained clean serving).", ("engine",))
+        self.engine_supervisor_heartbeat_age_seconds = r.gauge(
+            "gateway_engine_supervisor_heartbeat_age_seconds",
+            "Seconds since the scheduler loop last stamped its "
+            "heartbeat.", ("engine",))
+        self.engine_supervisor_backoff_seconds = r.gauge(
+            "gateway_engine_supervisor_backoff_seconds",
+            "Backoff the NEXT supervised restart attempt would wait.",
+            ("engine",))
+
+        # Write-behind usage recorder (ISSUE 14; db/recorder.py).
+        self.usage_recorder_queued = r.gauge(
+            "gateway_usage_recorder_queued_total",
+            "Usage rows waiting in the write-behind queue.")
+        self.usage_recorder_flushed_total = r.gauge(
+            "gateway_usage_recorder_flushed_total",
+            "Usage rows flushed to the ledger by the background "
+            "recorder.")
+        self.usage_recorder_dropped_total = r.gauge(
+            "gateway_usage_recorder_dropped_total",
+            "Usage rows dropped because the write-behind queue was "
+            "full.")
 
         # -- HBM memory ledger (ISSUE 8; obs/device.py). Static accounting
         #    from checkpoint dtypes + cache geometry, the live buffers'

@@ -205,7 +205,7 @@ class LocalProvider(Provider):
     # -- the provider contract -------------------------------------------------
     async def complete(self, request: CompletionRequest,
                        observer: UsageObserver) -> CompletionResult:
-        from ..engine.engine import EngineOverloaded
+        from ..engine.engine import EngineOverloaded, EngineUnavailable
         payload = request.payload
         model_name = str(payload.get("model", self.name))
         try:
@@ -235,6 +235,15 @@ class LocalProvider(Provider):
                              "without one", exc_info=True)
             return None, CompletionError(str(e), status=503,
                                          kind="overload", retry_after_s=hint)
+        except EngineUnavailable as e:
+            # Engine down/draining/restarting (ISSUE 14): a retryable
+            # 503 whose status feeds the breaker's failure window, so a
+            # few of these open the breaker and the router skips the
+            # local provider at ~0 cost until the supervisor recovers
+            # the engine and the half-open probe readmits it.
+            return None, CompletionError(
+                str(e), status=503, kind="engine_down",
+                retry_after_s=getattr(e, "retry_after_s", None))
         except Exception as e:
             logger.exception("engine submit failed")
             return None, CompletionError(f"local engine error: {e}")

@@ -1,0 +1,243 @@
+"""Engine lifecycle supervision: state machine, watchdog, restart backoff.
+
+The inference engine used to be a process-lifetime assumption — a dead
+scheduler loop or an XLA runtime error stranded every queued request
+with no recovery path (ISSUE 14). This module makes the engine a
+*supervised* component:
+
+* an explicit lifecycle state machine (``starting → serving → draining
+  → restarting → failed``) whose transitions happen ONLY through
+  :meth:`EngineSupervisor.transition` — generalizing the ad-hoc
+  ``_work_event`` rebinding fix from ISSUE 7 into a single place where
+  "what state is the engine in" is answerable and enforceable (the
+  ``lifecycle-discipline`` graftlint rule pins direct ``_lc_state``
+  writes to this file);
+* a heartbeat the scheduler loop stamps each step (piggybacked on the
+  flight-ring sequence number, so the heartbeat is free when the ring
+  is already recording) plus a watchdog deadline that distinguishes
+  "idle" from "silently stalled";
+* typed failure classification (:class:`EngineFailure`) separating
+  transient device/runtime errors — worth a supervised restart — from
+  fatal config/programming errors that restarting would just loop on;
+* bounded exponential restart backoff, and drain bookkeeping for
+  administrative restarts.
+
+The supervisor holds NO engine resources itself; the engine calls in.
+All mutable fields are scheduler-loop state, same contract as the
+flight recorder (enforced by the sanitizer's GuardTracker — this class
+is on the instrumented list).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable
+
+__all__ = ["EngineFailure", "EngineSupervisor", "LIFECYCLE_STATES",
+           "STATE_CODES"]
+
+# Lifecycle states, in rough severity order. STATE_CODES maps them onto
+# a [0, 1] gauge (``gateway_engine_supervisor_state_ratio``) the same
+# way breaker states map onto {0, 0.5, 1}: 0 = healthy/serving,
+# 1 = failed, intermediates = degraded.
+LIFECYCLE_STATES = ("starting", "serving", "draining", "restarting",
+                    "failed", "stopped")
+STATE_CODES = {"serving": 0.0, "starting": 0.25, "draining": 0.5,
+               "restarting": 0.75, "stopped": 0.9, "failed": 1.0}
+
+# Legal transitions. "stopped" is reachable from anywhere (stop() is
+# always allowed); "failed" likewise (a fatal fault can strike in any
+# state). Everything else must follow the lifecycle.
+_TRANSITIONS: dict[str, tuple[str, ...]] = {
+    "starting": ("serving", "failed", "stopped"),
+    "serving": ("draining", "restarting", "failed", "stopped"),
+    "draining": ("serving", "restarting", "failed", "stopped"),
+    "restarting": ("serving", "failed", "stopped"),
+    "failed": ("stopped",),
+    "stopped": ("starting", "serving"),
+}
+
+# Exception-text markers that mean "the device/runtime hiccupped" — the
+# restartable class. RESOURCE_EXHAUSTED is XLA's HBM-OOM status;
+# the rest are XLA/PJRT runtime failure shapes seen in practice.
+_TRANSIENT_MARKERS = ("RESOURCE_EXHAUSTED", "INTERNAL", "UNAVAILABLE",
+                     "DEADLINE_EXCEEDED", "ABORTED", "device", "xla",
+                     "pjrt")
+
+
+class EngineFailure(Exception):
+    """A classified step-loop failure.
+
+    ``kind`` is one of:
+
+    * ``transient`` — device/runtime error (XLA internal, HBM OOM,
+      injected chaos fault): supervised restart is worth attempting;
+    * ``stall`` — the watchdog declared the loop dead (heartbeat went
+      stale while work was pending): restart, same as transient;
+    * ``fatal`` — config/programming error (ValueError, TypeError,
+      assertion): restarting would loop on the same bug, so the engine
+      parks in ``failed`` and traffic stays on the fallback chain.
+    """
+
+    def __init__(self, message: str, *, kind: str = "transient",
+                 cause: BaseException | None = None):
+        super().__init__(message)
+        self.kind = kind
+        self.cause = cause
+
+    @classmethod
+    def classify(cls, exc: BaseException) -> "EngineFailure":
+        """Wrap an arbitrary step-loop exception with a failure kind."""
+        if isinstance(exc, EngineFailure):
+            return exc
+        msg = f"{type(exc).__name__}: {exc}"
+        # Programming/config errors restart into the same error; park.
+        if isinstance(exc, (ValueError, TypeError, KeyError,
+                            AttributeError, AssertionError)):
+            return cls(msg, kind="fatal", cause=exc)
+        low = msg.lower()
+        if any(m.lower() in low for m in _TRANSIENT_MARKERS):
+            return cls(msg, kind="transient", cause=exc)
+        # Unknown RuntimeError-ish failures default to transient: a
+        # restart that fails again escalates through the backoff cap,
+        # so optimism here is bounded, not unbounded.
+        return cls(msg, kind="transient", cause=exc)
+
+
+class EngineSupervisor:
+    """Lifecycle + health bookkeeping for one engine.
+
+    The engine owns the scheduler loop; the supervisor owns the *story*
+    of that loop — current state, heartbeat age, restart budget, drain
+    deadline. ``clock`` is injectable for fake-clock tests.
+    """
+
+    def __init__(self, *, watchdog_ms: float = 0.0, max_restarts: int = 3,
+                 backoff_ms: float = 50.0, backoff_max_ms: float = 5000.0,
+                 drain_deadline_ms: float = 10000.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_transition: Callable[[str, str, str], None] | None = None):
+        self._clock = clock
+        self.watchdog_ms = watchdog_ms
+        self.max_restarts = max_restarts
+        self.backoff_ms = backoff_ms
+        self.backoff_max_ms = backoff_max_ms
+        self.drain_deadline_ms = drain_deadline_ms
+        self._on_transition = on_transition
+        self._lc_state = "starting"          # guarded-by: loop
+        self._restarts = 0                   # guarded-by: loop
+        self._last_failure_kind = ""         # guarded-by: loop
+        self._last_failure_msg = ""          # guarded-by: loop
+        self._last_heartbeat = self._clock() # guarded-by: loop
+        self._heartbeat_seq = 0              # guarded-by: loop
+        self._drain_started: float | None = None  # guarded-by: loop
+        self._history: list[tuple[float, str, str, str]] = []  # guarded-by: loop
+
+    # -- state machine ------------------------------------------------------
+    @property
+    def state(self) -> str:
+        return self._lc_state
+
+    def transition(self, to: str, reason: str = "") -> None:
+        """The ONLY legal way to change lifecycle state (graftlint:
+        lifecycle-discipline). Raises on an illegal edge so a buggy
+        caller fails loudly instead of corrupting the story."""
+        if to not in LIFECYCLE_STATES:
+            raise ValueError(f"unknown lifecycle state {to!r}")
+        frm = self._lc_state
+        if to == frm:
+            return                      # idempotent (double stop() etc.)
+        if to not in _TRANSITIONS[frm]:
+            raise ValueError(
+                f"illegal lifecycle transition {frm!r} -> {to!r} ({reason})")
+        self._lc_state = to
+        if to == "draining":
+            self._drain_started = self._clock()
+        elif frm == "draining":
+            self._drain_started = None
+        # Bounded transition history: enough to reconstruct an incident
+        # from stats() without growing unboundedly across restarts.
+        self._history.append((self._clock(), frm, to, reason))
+        del self._history[:-32]
+        if self._on_transition is not None:
+            self._on_transition(frm, to, reason)
+
+    def is_accepting(self) -> bool:
+        """May submit() admit new work? (starting is accepting: submit
+        races engine start-up and the queue absorbs the gap.)"""
+        return self._lc_state in ("starting", "serving", "stopped")
+
+    # -- heartbeat / watchdog ----------------------------------------------
+    def heartbeat(self, seq: int = 0) -> None:
+        """Stamped by the scheduler loop each step; ``seq`` is the
+        flight-ring sequence so stats can expose 'last step = ring
+        record N' for free."""
+        self._last_heartbeat = self._clock()
+        self._heartbeat_seq = seq
+
+    def heartbeat_age_s(self) -> float:
+        return max(0.0, self._clock() - self._last_heartbeat)
+
+    def is_stalled(self, busy: bool) -> bool:
+        """Watchdog predicate: stale heartbeat counts only while the
+        engine *should* be stepping (``busy``) — an idle engine parks
+        on its work event legitimately."""
+        if self.watchdog_ms <= 0 or not busy:
+            return False
+        return self.heartbeat_age_s() * 1000.0 > self.watchdog_ms
+
+    # -- restart budget -----------------------------------------------------
+    def note_failure(self, failure: EngineFailure) -> None:
+        self._last_failure_kind = failure.kind
+        self._last_failure_msg = str(failure)[:500]
+
+    def can_restart(self) -> bool:
+        return self._restarts < self.max_restarts
+
+    def backoff_s(self) -> float:
+        """Bounded exponential backoff for the NEXT restart attempt."""
+        ms = min(self.backoff_max_ms,
+                 self.backoff_ms * (2.0 ** self._restarts))
+        return ms / 1000.0
+
+    def note_restart(self) -> None:
+        self._restarts += 1
+
+    def reset_restarts(self) -> None:
+        """A healthy serving stretch re-earns the full restart budget
+        (callers invoke this after sustained successful stepping)."""
+        self._restarts = 0
+
+    # -- drain --------------------------------------------------------------
+    def drain_elapsed_s(self) -> float:
+        if self._drain_started is None:
+            return 0.0
+        return max(0.0, self._clock() - self._drain_started)
+
+    def drain_expired(self, deadline_s: float | None = None) -> bool:
+        if self._drain_started is None:
+            return False
+        limit = self.drain_deadline_ms / 1000.0 \
+            if deadline_s is None else deadline_s
+        return self.drain_elapsed_s() > limit
+
+    # -- reporting ----------------------------------------------------------
+    def state_code(self) -> float:
+        return STATE_CODES.get(self._lc_state, 1.0)
+
+    def stats(self) -> dict[str, Any]:
+        return {
+            "supervisor_state": self._lc_state,
+            "supervisor_state_code": self.state_code(),
+            "supervisor_restarts_total": self._restarts,
+            "supervisor_max_restarts": self.max_restarts,
+            "supervisor_last_failure_kind": self._last_failure_kind,
+            "supervisor_last_failure": self._last_failure_msg,
+            "supervisor_heartbeat_age_seconds": round(self.heartbeat_age_s(), 3),
+            "supervisor_heartbeat_seq": self._heartbeat_seq,
+            "supervisor_backoff_seconds": self.backoff_s(),
+            "supervisor_watchdog_ms": self.watchdog_ms,
+            "supervisor_drain_elapsed_seconds": round(self.drain_elapsed_s(), 3),
+            "supervisor_transitions": [
+                {"t": round(t, 3), "from": f, "to": to, "reason": r}
+                for (t, f, to, r) in self._history[-8:]],
+        }

@@ -150,6 +150,13 @@ class ProviderRegistry:
         observability endpoints (server/profiler_api.py)."""
         return [(name, prov) for name, (_, prov) in self._cache.items()]
 
+    def local_providers(self) -> list[Provider]:
+        """Already-built providers backed by an in-process engine — the
+        drain / SIGTERM surface (ISSUE 14). Builds nothing: a provider
+        that never served has nothing to drain."""
+        return [prov for _, prov in self.instantiated()
+                if getattr(prov, "engine", None) is not None]
+
     def _retire(self, provider: Provider) -> None:
         async def _close_later() -> None:
             try:

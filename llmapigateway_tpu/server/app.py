@@ -17,6 +17,7 @@ from aiohttp import web
 
 from ..config.loader import ConfigLoader
 from ..config.settings import Settings
+from ..db.recorder import UsageRecorder
 from ..db.rotation import RotationDB
 from ..db.usage import UsageDB
 from ..obs.metrics import GatewayMetrics, get_metrics
@@ -48,6 +49,12 @@ class GatewayApp:
         self.settings = settings
         self.loader = loader
         self.usage_db = UsageDB(settings.db_dir or "db")
+        # Write-behind usage recording (ISSUE 14): stream-end observers
+        # enqueue; one background flusher owns the SQLite writes. The
+        # recorder duck-types UsageDB.insert, so chat.py hands it to
+        # UsageCollector unchanged; close() drains before the DB closes
+        # so process exit never loses completed requests' rows.
+        self.usage_recorder = UsageRecorder(self.usage_db)
         self.rotation_db = RotationDB(settings.db_dir or "db")
         self.registry = ProviderRegistry(loader, local_factory=local_factory)
         self.breakers = BreakerRegistry(loader)
@@ -69,8 +76,29 @@ class GatewayApp:
     async def close(self) -> None:
         self.metrics.registry.unregister_collector(self._stats_collector)
         await self.registry.close()
+        # Recorder before DB: drain the write-behind queue while the
+        # connection is still open (flush-on-shutdown contract).
+        await asyncio.to_thread(self.usage_recorder.close)
         self.usage_db.close()
         self.rotation_db.close()
+
+    async def drain_local_engines(self, *, restart: bool = False) -> list:
+        """Administrative drain of every local provider's engine
+        (ISSUE 14): planned restart / SIGTERM path. Flushes the usage
+        recorder afterwards so interrupted streams' partial rows are
+        durable before the caller exits or reloads."""
+        results = []
+        for provider in self.registry.local_providers():
+            engine = getattr(provider, "engine", None)
+            if engine is None:
+                continue
+            try:
+                results.append(await engine.drain(restart=restart))
+            except Exception:
+                logger.exception("drain failed for provider %r",
+                                 getattr(provider, "name", "?"))
+        await asyncio.to_thread(self.usage_recorder.flush)
+        return results
 
 
 async def _health(request: web.Request) -> web.Response:
@@ -154,6 +182,35 @@ def build_app(settings: Settings | None = None,
         # Daily retention sweep — the reference defines a 180-day cleanup but
         # never calls it (tokens_usage_db.py:164); here it's actually wired.
         import asyncio
+
+        # Graceful drain on SIGTERM (ISSUE 14): stop engine admissions,
+        # let in-flight decodes finish under the drain deadline, flush
+        # the usage recorder, then let aiohttp's own shutdown proceed.
+        # Best-effort: non-main-thread loops (tests) can't install
+        # signal handlers and don't need them.
+        import signal
+
+        def _on_sigterm() -> None:
+            logger.info("SIGTERM: draining local engines before exit")
+            asyncio.get_running_loop().create_task(_drain_and_exit())
+
+        async def _drain_and_exit() -> None:
+            try:
+                await gw.drain_local_engines(restart=False)
+            finally:
+                # GracefulExit is a SystemExit: raised from a plain loop
+                # callback it propagates out of run_forever and stops
+                # web.run_app (a task would swallow it into its result).
+                def _exit() -> None:
+                    raise web.GracefulExit()
+                asyncio.get_running_loop().call_soon(_exit)
+
+        try:
+            asyncio.get_running_loop().add_signal_handler(
+                signal.SIGTERM, _on_sigterm)
+        except (NotImplementedError, RuntimeError, ValueError):
+            logger.debug("SIGTERM drain handler not installed",
+                         exc_info=True)
 
         async def _retention_loop() -> None:
             while True:

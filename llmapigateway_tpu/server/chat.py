@@ -134,9 +134,13 @@ async def chat_completions(request: web.Request) -> web.StreamResponse:
 
 def _make_collector(provider: str, model: str, *, payload: dict, gw) -> UsageCollector:
     settings = gw.settings
+    # The write-behind recorder (ISSUE 14) duck-types UsageDB.insert:
+    # stream-end observers enqueue instead of fsyncing SQLite inline.
+    # Test-built GatewayApp stand-ins without a recorder fall through
+    # to the raw DB.
     return UsageCollector(
         provider=provider, model=model,
-        usage_db=gw.usage_db,
+        usage_db=getattr(gw, "usage_recorder", None) or gw.usage_db,
         request_payload=payload if settings.log_chat_messages else {},
         logs_dir=settings.logs_dir,
         log_chat_messages=settings.log_chat_messages,

@@ -67,6 +67,12 @@ _ENGINE_GAUGES = (
     ("disagg_handoffs", "engine_disagg_handoffs_total", 1.0),
     ("disagg_handoff_pages", "engine_disagg_handoff_pages_total", 1.0),
     ("disagg_clamps", "engine_disagg_clamps_total", 1.0),
+    # Engine supervision (ISSUE 14): lifecycle state + restart budget.
+    ("supervisor_state_code", "engine_supervisor_state_ratio", 1.0),
+    ("supervisor_restarts_total", "engine_supervisor_restarts_total", 1.0),
+    ("supervisor_heartbeat_age_seconds",
+     "engine_supervisor_heartbeat_age_seconds", 1.0),
+    ("supervisor_backoff_seconds", "engine_supervisor_backoff_seconds", 1.0),
 )
 
 # stats()["pools"][pool] key → GatewayMetrics attribute (plus scale),
@@ -185,6 +191,18 @@ def make_stats_collector(gw) -> "callable":
                     provider=name).set(snap.get("state_code", 0.0))
                 metrics.provider_breaker_opens_total.labels(
                     provider=name).set(snap.get("opens", 0))
+        # Write-behind usage recorder (ISSUE 14): queue depth + drop
+        # counter — a nonzero drop rate means the ledger is lossy under
+        # the current incident load.
+        recorder = getattr(gw, "usage_recorder", None)
+        if recorder is not None:
+            rstats = recorder.stats()
+            metrics.usage_recorder_queued.set(
+                rstats["usage_recorder_queued"])
+            metrics.usage_recorder_flushed_total.set(
+                rstats["usage_recorder_flushed_total"])
+            metrics.usage_recorder_dropped_total.set(
+                rstats["usage_recorder_dropped_total"])
 
     return collect
 

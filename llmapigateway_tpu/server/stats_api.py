@@ -66,6 +66,19 @@ async def get_provider_health(request: web.Request) -> web.Response:
                         if details.breaker is not None else True),
         }
         entry["type"] = details.type
+        if details.type == "local":
+            # Engine supervisor block (ISSUE 14): lifecycle state,
+            # restart budget, heartbeat age — only for providers whose
+            # engine is actually built (building one here would block
+            # a health probe on a checkpoint load).
+            for prov in gw.registry.instantiated():
+                if prov[0] != name:
+                    continue
+                engine = getattr(prov[1], "engine", None)
+                sup = getattr(engine, "supervisor", None)
+                if sup is not None:
+                    entry["supervisor"] = sup.stats()
+                break
         providers[name] = entry
     # Breakers for providers since removed from config still report until
     # their registry entry ages out — visibility beats tidiness here.

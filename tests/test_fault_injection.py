@@ -1,8 +1,11 @@
 """Engine fault injection (SURVEY.md §5): injected prefill/decode failures
 must surface as clean error deltas (pre-commit failures → provider error →
 fallback; mid-stream failures → error frame), and the engine must recover
-to serve subsequent requests."""
+to serve subsequent requests — since ISSUE 14 that recovery is a
+supervised restart, so the follow-up request waits for the supervisor to
+finish it instead of racing the backoff window."""
 import asyncio
+import time
 
 import pytest
 
@@ -14,7 +17,9 @@ from llmapigateway_tpu.engine.engine import FaultPlan, GenRequest, InferenceEngi
 def engine(stop_engine):
     cfg = LocalEngineConfig(kv_layout="contiguous",
         preset="tiny-test", max_batch_size=2,
-                            max_seq_len=64, prefill_chunk=8, decode_burst=2)
+                            max_seq_len=64, prefill_chunk=8, decode_burst=2,
+                            supervisor={"max_restarts": 10,
+                                        "backoff_ms": 10.0})
     eng = InferenceEngine(cfg)
     yield eng
     stop_engine(eng)
@@ -29,6 +34,15 @@ async def _run(engine, prompt_ids, max_tokens=6):
     return req, deltas
 
 
+async def _wait_recovered(engine, timeout_s=10.0):
+    """Block until the supervised restart finished (submit would raise
+    EngineUnavailable while the engine is still restarting)."""
+    t0 = time.monotonic()
+    while engine.supervisor.state not in ("serving", "stopped"):
+        assert time.monotonic() - t0 < timeout_s, engine.supervisor.state
+        await asyncio.sleep(0.01)
+
+
 async def test_prefill_fault_yields_error_before_any_text(engine):
     engine.fault_plan = FaultPlan(fail_prefill_after=0)
     try:
@@ -37,7 +51,8 @@ async def test_prefill_fault_yields_error_before_any_text(engine):
         assert all(not d.text for d in deltas)
     finally:
         engine.fault_plan = None
-    # Engine recovered: next request completes normally.
+    # Engine recovered (supervised restart): next request completes.
+    await _wait_recovered(engine)
     req, deltas = await _run(engine, [1, 2, 3])
     assert req.finish_reason is not None and deltas[-1].error is None
 
@@ -49,6 +64,7 @@ async def test_decode_fault_midstream_emits_error_and_recovers(engine):
         assert deltas[-1].error is not None
     finally:
         engine.fault_plan = None
+    await _wait_recovered(engine)
     req, deltas = await _run(engine, [4, 5, 6])
     assert req.finish_reason is not None and deltas[-1].error is None
 

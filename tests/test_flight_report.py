@@ -200,6 +200,51 @@ def test_unknown_pool_name_gets_overflow_lane():
     assert len(metas) == 1
 
 
+SUPERVISOR_DOC = {"records": [
+    {"seq": 0, "t": 20.0, "kind": "step", "dur_ms": 4.0,
+     "step_kind": "decode", "burst_depth": 1, "tokens": 1,
+     "busy": False, "clamped": False},
+    {"seq": 1, "t": 20.01, "kind": "supervisor", "state": "restarting",
+     "reason": "engine failure (transient): boom"},
+    {"seq": 2, "t": 20.05, "kind": "supervisor", "state": "serving",
+     "reason": "restart #1 complete"},
+]}
+
+# Golden pin for the supervisor instants (ISSUE 14): epoch = 19.996 s
+# (first slice start); transitions render as GLOBAL instants on the
+# lifecycle track named by the state entered, full record in args.
+SUPERVISOR_GOLDEN = [
+    {"ph": "M", "pid": 1, "name": "process_name",
+     "args": {"name": "engine:engine"}, "ts": 0},
+    {"ph": "M", "pid": 1, "tid": 0, "name": "thread_name",
+     "args": {"name": "scheduler"}, "ts": 0},
+    {"ph": "M", "pid": 1, "tid": 1, "name": "thread_name",
+     "args": {"name": "lifecycle"}, "ts": 0},
+    {"ph": "X", "pid": 1, "tid": 0, "name": "decode[1]", "cat": "step",
+     "ts": 0, "dur": 4000,
+     "args": {"seq": 0, "kind": "step", "dur_ms": 4.0,
+              "step_kind": "decode", "burst_depth": 1, "tokens": 1,
+              "busy": False, "clamped": False}},
+    {"ph": "i", "s": "g", "pid": 1, "tid": 1,
+     "name": "supervisor:restarting", "cat": "supervisor", "ts": 14000,
+     "args": {"seq": 1, "kind": "supervisor", "state": "restarting",
+              "reason": "engine failure (transient): boom"}},
+    {"ph": "i", "s": "g", "pid": 1, "tid": 1,
+     "name": "supervisor:serving", "cat": "supervisor", "ts": 54000,
+     "args": {"seq": 2, "kind": "supervisor", "state": "serving",
+              "reason": "restart #1 complete"}},
+]
+
+
+def test_supervisor_instants_golden():
+    """ISSUE 14: engine supervisor transitions render as global instants
+    on the lifecycle track (supervisor:<state>), bracketing the steps the
+    incident interrupted; they do NOT also emit a plain lifecycle
+    instant (the generic 'kind' fallback is bypassed)."""
+    out = flight_report.convert(SUPERVISOR_DOC)
+    assert out["traceEvents"] == SUPERVISOR_GOLDEN
+
+
 def test_spec_step_name_carries_accepted_tokens():
     """ISSUE 10: SPEC step records carry their accepted-draft yield and
     the converter surfaces it in the slice name (plus the full record in

@@ -223,6 +223,48 @@ def test_lock_discipline_silent_on_good():
     assert rules_hit(LOCK_GOOD, "db/fixture.py") == set()
 
 
+LIFECYCLE_BAD = """
+    class Recovery:
+        def force_serving(self, engine):
+            engine.supervisor._lc_state = "serving"
+
+        def park(self, sup):
+            setattr(sup, "_lc_state", "failed")
+"""
+
+LIFECYCLE_GOOD = """
+    class Recovery:
+        def force_serving(self, engine):
+            engine.supervisor.transition("serving", "recovered")
+
+        def read_state(self, sup):
+            return sup._lc_state            # reads are fine
+"""
+
+
+def test_lifecycle_discipline_fires_on_bad():
+    findings = [f for f in lint(LIFECYCLE_BAD, "engine/fixture.py")
+                if f.rule == "lifecycle-discipline"]
+    msgs = " | ".join(f.message for f in findings)
+    assert "direct write to '_lc_state'" in msgs
+    assert "setattr on '_lc_state'" in msgs
+    assert len(findings) == 2
+
+
+def test_lifecycle_discipline_silent_on_good():
+    assert "lifecycle-discipline" not in rules_hit(
+        LIFECYCLE_GOOD, "engine/fixture.py")
+
+
+def test_lifecycle_discipline_exempts_supervisor_module():
+    # The state machine's own module seeds and stores _lc_state — that
+    # is the ONE place allowed to.
+    findings = [f for f in lint(LIFECYCLE_BAD,
+                                "reliability/supervisor.py")
+                if f.rule == "lifecycle-discipline"]
+    assert findings == []
+
+
 SECRET_BAD = """
     import logging
     logger = logging.getLogger(__name__)

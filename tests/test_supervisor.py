@@ -1,0 +1,274 @@
+"""Engine supervisor unit tests (ISSUE 14): lifecycle state machine,
+watchdog/heartbeat predicates, restart backoff, drain bookkeeping, and
+failure classification — all on an injectable fake clock (zero real
+sleeps), plus the write-behind usage recorder's flush/drop/close
+semantics against a real on-disk ledger."""
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from llmapigateway_tpu.db.recorder import UsageRecorder
+from llmapigateway_tpu.db.usage import UsageDB, UsageRecord
+from llmapigateway_tpu.reliability.supervisor import (
+    LIFECYCLE_STATES,
+    STATE_CODES,
+    EngineFailure,
+    EngineSupervisor,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def make_sup(clock=None, **kw) -> EngineSupervisor:
+    return EngineSupervisor(clock=clock or FakeClock(), **kw)
+
+
+# -- state machine ------------------------------------------------------------
+
+def test_lifecycle_happy_path_and_codes():
+    sup = make_sup()
+    assert sup.state == "starting"
+    sup.transition("serving", "loop started")
+    assert sup.state == "serving" and sup.state_code() == 0.0
+    sup.transition("draining", "hot reload")
+    sup.transition("restarting", "drain complete")
+    sup.transition("serving", "restart complete")
+    sup.transition("stopped", "shutdown")
+    assert sup.state == "stopped"
+    # Every state has a gauge code and every code is in [0, 1].
+    assert set(STATE_CODES) == set(LIFECYCLE_STATES)
+    assert all(0.0 <= c <= 1.0 for c in STATE_CODES.values())
+
+
+def test_illegal_edges_raise_and_leave_state_intact():
+    sup = make_sup()
+    with pytest.raises(ValueError, match="illegal lifecycle transition"):
+        sup.transition("draining")       # starting -> draining is not legal
+    assert sup.state == "starting"
+    sup.transition("serving")
+    sup.transition("failed", "fatal fault")
+    # failed is terminal except for an explicit stop().
+    for to in ("serving", "restarting", "draining", "starting"):
+        with pytest.raises(ValueError):
+            sup.transition(to)
+    sup.transition("stopped", "admin stop")
+    assert sup.state == "stopped"
+    with pytest.raises(ValueError, match="unknown lifecycle state"):
+        sup.transition("zombie")
+
+
+def test_same_state_transition_is_noop():
+    """Double stop() (fixture teardown + explicit stop) must not raise
+    and must not spam the history."""
+    sup = make_sup()
+    sup.transition("serving")
+    sup.transition("stopped")
+    sup.transition("stopped")
+    transitions = sup.stats()["supervisor_transitions"]
+    assert [t["to"] for t in transitions] == ["serving", "stopped"]
+
+
+def test_transition_callback_and_bounded_history():
+    seen = []
+    clock = FakeClock()
+    sup = EngineSupervisor(clock=clock,
+                           on_transition=lambda f, t, r: seen.append((f, t, r)))
+    sup.transition("serving", "up")
+    assert seen == [("starting", "serving", "up")]
+    for i in range(40):
+        sup.transition("restarting", f"r{i}")
+        sup.transition("serving", f"s{i}")
+    assert len(sup._history) == 32       # bounded, newest kept
+    tail = sup.stats()["supervisor_transitions"]
+    assert len(tail) == 8 and tail[-1]["reason"] == "s39"
+
+
+def test_is_accepting_by_state():
+    sup = make_sup()
+    assert sup.is_accepting()            # starting: queue absorbs the gap
+    sup.transition("serving")
+    assert sup.is_accepting()
+    sup.transition("draining")
+    assert not sup.is_accepting()
+    sup.transition("restarting")
+    assert not sup.is_accepting()
+    sup.transition("failed")
+    assert not sup.is_accepting()
+    sup.transition("stopped")
+    assert sup.is_accepting()            # submit() auto-starts a stopped engine
+
+
+# -- heartbeat / watchdog -----------------------------------------------------
+
+def test_watchdog_stale_heartbeat_only_counts_while_busy():
+    clock = FakeClock()
+    sup = make_sup(clock, watchdog_ms=100.0)
+    sup.heartbeat(seq=7)
+    assert sup.heartbeat_age_s() == 0.0
+    clock.advance(0.05)
+    assert not sup.is_stalled(busy=True)         # under deadline
+    clock.advance(0.1)
+    assert sup.is_stalled(busy=True)             # 150 ms > 100 ms, busy
+    assert not sup.is_stalled(busy=False)        # idle engines never stall
+    sup.heartbeat(seq=8)
+    assert not sup.is_stalled(busy=True)         # fresh stamp resets the age
+    assert sup.stats()["supervisor_heartbeat_seq"] == 8
+
+
+def test_watchdog_disabled_when_deadline_zero():
+    clock = FakeClock()
+    sup = make_sup(clock, watchdog_ms=0.0)
+    clock.advance(3600.0)
+    assert not sup.is_stalled(busy=True)
+
+
+# -- restart budget -----------------------------------------------------------
+
+def test_backoff_doubles_then_caps():
+    sup = make_sup(backoff_ms=50.0, backoff_max_ms=300.0, max_restarts=10)
+    got = []
+    for _ in range(6):
+        got.append(sup.backoff_s())
+        sup.note_restart()
+    assert got == [0.05, 0.10, 0.20, 0.30, 0.30, 0.30]
+
+
+def test_restart_budget_exhausts_and_reset_reearns_it():
+    sup = make_sup(max_restarts=2)
+    assert sup.can_restart()
+    sup.note_restart()
+    sup.note_restart()
+    assert not sup.can_restart()
+    sup.reset_restarts()                 # a healthy serving stretch
+    assert sup.can_restart() and sup.backoff_s() == pytest.approx(0.05)
+
+
+# -- drain --------------------------------------------------------------------
+
+def test_drain_elapsed_and_deadline_expiry():
+    clock = FakeClock()
+    sup = make_sup(clock, drain_deadline_ms=200.0)
+    sup.transition("serving")
+    assert sup.drain_elapsed_s() == 0.0 and not sup.drain_expired()
+    sup.transition("draining", "SIGTERM")
+    clock.advance(0.1)
+    assert sup.drain_elapsed_s() == pytest.approx(0.1)
+    assert not sup.drain_expired()
+    clock.advance(0.15)
+    assert sup.drain_expired()           # 250 ms > 200 ms deadline
+    assert sup.drain_expired(deadline_s=0.2)
+    assert not sup.drain_expired(deadline_s=1.0)
+    sup.transition("serving", "drain aborted")
+    assert sup.drain_elapsed_s() == 0.0 and not sup.drain_expired()
+
+
+def test_stats_shape():
+    clock = FakeClock()
+    sup = make_sup(clock, watchdog_ms=100.0)
+    sup.transition("serving")
+    sup.note_failure(EngineFailure("boom", kind="transient"))
+    s = sup.stats()
+    assert s["supervisor_state"] == "serving"
+    assert s["supervisor_state_code"] == 0.0
+    assert s["supervisor_restarts_total"] == 0
+    assert s["supervisor_max_restarts"] == 3
+    assert s["supervisor_last_failure_kind"] == "transient"
+    assert s["supervisor_last_failure"] == "boom"
+    assert s["supervisor_watchdog_ms"] == 100.0
+    assert s["supervisor_backoff_seconds"] == pytest.approx(0.05)
+    assert isinstance(s["supervisor_transitions"], list)
+
+
+# -- failure classification ---------------------------------------------------
+
+def test_classify_programming_errors_as_fatal():
+    for exc in (ValueError("bad shape"), TypeError("no"), KeyError("k"),
+                AttributeError("x"), AssertionError("inv")):
+        f = EngineFailure.classify(exc)
+        assert f.kind == "fatal" and f.cause is exc
+        assert type(exc).__name__ in str(f)
+
+
+def test_classify_device_runtime_errors_as_transient():
+    for msg in ("RESOURCE_EXHAUSTED: out of memory while trying to allocate",
+                "INTERNAL: Failed to execute XLA runtime",
+                "PJRT_Error: device lost",
+                "jaxlib.xla_extension.XlaRuntimeError: ABORTED"):
+        f = EngineFailure.classify(RuntimeError(msg))
+        assert f.kind == "transient", msg
+
+
+def test_classify_unknown_defaults_to_transient_and_passthrough():
+    f = EngineFailure.classify(RuntimeError("???"))
+    assert f.kind == "transient"         # bounded optimism via backoff cap
+    original = EngineFailure("stall", kind="stall")
+    assert EngineFailure.classify(original) is original
+
+
+# -- write-behind usage recorder ----------------------------------------------
+
+def test_recorder_flush_makes_rows_durable(tmp_path):
+    db = UsageDB(tmp_path / "db")
+    rec = UsageRecorder(db)
+    try:
+        for i in range(5):
+            rec.insert(UsageRecord(model=f"m{i}", provider="tpu",
+                                   prompt_tokens=1, completion_tokens=i))
+        assert rec.flush()
+        assert db.total_count() == 5
+        s = rec.stats()
+        assert s["usage_recorder_enqueued_total"] == 5
+        assert s["usage_recorder_flushed_total"] == 5
+        assert s["usage_recorder_dropped_total"] == 0
+    finally:
+        rec.close()
+        db.close()
+
+
+def test_recorder_full_queue_drops_and_counts(tmp_path):
+    class BlockedDB:
+        """Never finishes an insert — models a wedged ledger."""
+        def __init__(self):
+            self.release = False
+
+        def insert(self, rec):
+            while not self.release:
+                time.sleep(0.001)
+
+    db = BlockedDB()
+    rec = UsageRecorder(db, maxsize=2)
+    try:
+        # One row may be in the flusher's hands; the queue holds 2 more.
+        for _ in range(8):
+            rec.insert(UsageRecord(model="m"))
+        s = rec.stats()
+        assert s["usage_recorder_dropped_total"] >= 5
+        assert s["usage_recorder_enqueued_total"] + \
+            s["usage_recorder_dropped_total"] == 8
+    finally:
+        db.release = True
+        rec.close()
+
+
+def test_recorder_close_drains_then_inserts_go_direct(tmp_path):
+    db = UsageDB(tmp_path / "db")
+    rec = UsageRecorder(db)
+    rec.insert(UsageRecord(model="before-close"))
+    rec.close()
+    rec.close()                          # idempotent
+    assert db.total_count() == 1
+    # Late straggler after close: written synchronously, never lost.
+    rec.insert(UsageRecord(model="after-close"))
+    assert db.total_count() == 2
+    db.close()

@@ -23,7 +23,11 @@ Tracks per engine (one trace-event process):
   the single ``scheduler`` lane, byte-identical to pre-pool traces;
 * ``lifecycle`` — instant events for admissions, sheds, and prefix-cache
   evictions (request ids attached, linking back to
-  ``/v1/api/trace/{id}`` via the records' ``seq`` numbers);
+  ``/v1/api/trace/{id}`` via the records' ``seq`` numbers); engine
+  supervisor transitions (ISSUE 14) render as global instants named by
+  the state entered (``supervisor:restarting``, ``supervisor:draining``)
+  so an incident's RESTART/DRAIN edges bracket the steps they
+  interrupted;
 * ``slot N`` — one slice per request's residency in a slot, from its
   admit record to its finish record, named by request id.
 
@@ -99,6 +103,18 @@ def engine_events(engine: str, records: list[dict[str, Any]],
                 "ph": "X", "pid": pid, "tid": tid,
                 "name": _step_name(rec), "cat": "step",
                 "ts": us(rec["t"]) - dur_us, "dur": dur_us,
+                "args": {k: v for k, v in rec.items() if k != "t"},
+            })
+            continue
+        if kind == "supervisor":
+            # Engine lifecycle transition (ISSUE 14): a global instant
+            # named by the state entered (supervisor:restarting,
+            # supervisor:draining, …) so an incident's RESTART/DRAIN
+            # edges bracket the steps they interrupted.
+            events.append({
+                "ph": "i", "s": "g", "pid": pid, "tid": TID_LIFECYCLE,
+                "name": f"supervisor:{rec.get('state', '?')}",
+                "cat": "supervisor", "ts": us(rec["t"]),
                 "args": {k: v for k, v in rec.items() if k != "t"},
             })
             continue
